@@ -1,0 +1,28 @@
+"""torchdistx_tpu — a TPU-native framework with the capabilities of torchdistX.
+
+Features (capability parity with /root/reference, rebuilt TPU-first):
+
+* :mod:`torchdistx_tpu.fake` — fake tensors: storage-less tensors claiming a
+  real (possibly absent) device, for zero-memory model construction.
+* :mod:`torchdistx_tpu.deferred_init` — deferred module initialization: record
+  construction into an op tape, inspect, then materialize.
+* :mod:`torchdistx_tpu.materialize` — JAX/XLA materialization: replay the tape
+  directly as (sharded) ``jax.Array`` parameters on a TPU mesh.
+* :mod:`torchdistx_tpu.parallel` — mesh/sharding plans (FSDP/TP/DP/SP) and the
+  SlowMo communication-efficient distributed optimizer over ICI/DCN axes.
+* :mod:`torchdistx_tpu.models` — JAX-native model implementations used as
+  training-step flagships.
+
+JAX-dependent modules import lazily; ``import torchdistx_tpu`` itself only
+needs torch.
+"""
+
+__version__ = "0.1.0.dev0"
+
+# Like the reference (src/python/torchdistx/__init__.py), the package init
+# stays minimal; features live in submodules (`torchdistx_tpu.fake`,
+# `torchdistx_tpu.deferred_init`, ...).  Re-exporting the `deferred_init`
+# function here would shadow its submodule.
+from . import fake  # noqa: F401
+from . import deferred_init  # noqa: F401
+from .fake import FakeTensor, fake_mode, is_fake, meta_like  # noqa: F401

@@ -1,0 +1,416 @@
+"""The deferred-init op tape: a bidirectional op graph with mutation semantics.
+
+Rebuild of the reference's recorder (/root/reference/src/cc/torchdistx/
+deferred_init.cc:102-710): ``Op`` (recorded call + deep-copied args +
+thread-local state), ``OpNode`` (chronological ``op_nr``, dependency edges,
+output-storage sets for aliasing, external-tensor version guards),
+``TensorRecord`` (per-fake side data naming the producing (node, index)), and
+the materializer's call-stack builder (last-in-place-op horizon search +
+transitive-closure collection + chronological sort, deferred_init.cc:529-621).
+
+Differences from the reference, by design:
+
+* Mutation tracking uses operator *schemas* (``alias_info.is_write``) instead
+  of the reference's name heuristics — the schema is ground truth here.
+* Aliasing is tracked through the fakes' **meta shadow storages** (meta
+  tensors have real storage identity but no data), which is exactly the
+  role the reference's output-storage sets play (deferred_init.cc:416-428).
+* Replay caching is per-node (``Op::materialize`` runs once,
+  deferred_init.cc:255-271) and dependency edges are dropped after replay to
+  free the graph incrementally (deferred_init.cc:521-523).
+
+A C++ implementation of the graph core (node table, alias index, horizon
+search, closure building) lives in ``csrc/tape_core.cc`` and is used when
+built; this module is the reference semantics and the fallback.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import weakref
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import torch
+import torch.utils._pytree as pytree
+
+_tls = threading.local()
+
+
+class OutputRef:
+    """Marker replacing a fake-tensor argument inside a recorded arg stack.
+
+    Analog of the reference's dependency ``OpOutputDescriptor``
+    (deferred_init.cc:106-154): names the producing node + output index, and
+    holds the node strongly (keep-alive, like TensorRecord's view refs).
+    """
+
+    __slots__ = ("node", "index")
+
+    def __init__(self, node: "OpNode", index: int):
+        self.node = node
+        self.index = index
+
+    def __repr__(self):
+        return f"OutputRef(op_nr={self.node.op_nr}, index={self.index})"
+
+
+@dataclass
+class ExternalTensorGuard:
+    """Version guard for a real (non-fake) tensor captured by the tape.
+
+    Analog of deferred_init.cc:394,480-489/639-666: replaying an op whose
+    external input has since been mutated would silently produce different
+    values, so record its version counter and verify at replay.
+    """
+
+    tensor: torch.Tensor
+    version: int
+
+    def check(self) -> None:
+        if self.tensor.is_inference():
+            raise RuntimeError(
+                "Cannot materialize: a recorded operation captured an "
+                "inference-mode tensor."
+            )
+        if self.tensor._version != self.version:
+            raise RuntimeError(
+                "Cannot materialize: an external tensor captured by a "
+                "recorded operation was mutated after recording "
+                f"(version {self.tensor._version} != {self.version})."
+            )
+
+
+@dataclass
+class TensorRecord:
+    """Per-fake-tensor side data: who produced it (deferred_init.cc:106-154)."""
+
+    node: "OpNode"
+    index: int
+
+
+class Op:
+    """A recorded operation: callable + deep-copied boxed arguments + TLS.
+
+    Analog of ``Op`` (deferred_init.cc:102-300).  Args are deep-copied at
+    record time (copyStack, deferred_init.cc:69-100) with fake tensors
+    replaced by :class:`OutputRef` edges and real tensors guarded.  Replay
+    runs once and caches outputs (deferred_init.cc:255-271) under the grad
+    mode captured at record time (the ThreadLocalState analog,
+    deferred_init.cc:211-215).
+    """
+
+    __slots__ = (
+        "name",
+        "func",
+        "args",
+        "kwargs",
+        "grad_enabled",
+        "guards",
+        "replayed",
+        "outputs",
+    )
+
+    def __init__(self, name, func, args, kwargs, grad_enabled, guards):
+        self.name = name
+        self.func = func
+        self.args = args
+        self.kwargs = kwargs
+        self.grad_enabled = grad_enabled
+        self.guards: List[ExternalTensorGuard] = guards
+        self.replayed = False
+        self.outputs: Optional[List[Any]] = None
+
+
+class OpNode:
+    """Graph node — analog of ``OpNode`` (deferred_init.cc:311-710)."""
+
+    __slots__ = (
+        "op_nr",
+        "op",
+        "deps",
+        "dependents",
+        "out_storages",
+        "write_storages",
+        "pinned_storages",
+        "num_outputs",
+        "materialized_pyobjs",
+        "__weakref__",
+    )
+
+    def __init__(self, op_nr: int, op: Op, deps: List["OpNode"]):
+        self.op_nr = op_nr
+        self.op = op
+        # Strong dependency edges (deferred_init.cc:390).
+        self.deps = deps
+        # Back-edges to later ops touching any of this node's storages — the
+        # analog of the reference's `dependents_` (deferred_init.cc:397).
+        # Strong refs (the GC collects cycles) which also provides the
+        # view-record keep-alive the reference implements separately
+        # (ensureViewsKeptAlive, deferred_init.cc:430-461): a later in-place
+        # op on a view stays reachable from the base's producing node even if
+        # the view object is dropped.
+        self.dependents: List["OpNode"] = []
+        self.out_storages: List[int] = []
+        self.write_storages: List[int] = []
+        # Keep the meta storage objects alive: storage keys are raw
+        # StorageImpl addresses, and a freed address could be reused by an
+        # unrelated tensor, creating false alias edges.  The reference pins
+        # refcounted c10::Storage objects the same way
+        # (deferred_init.cc:387,416-428).
+        self.pinned_storages: List[Any] = []
+        self.num_outputs = 0
+        # Python-identity cache: materializing the same output twice returns
+        # the same object (the reference's pyobj reuse, _C/deferred_init.cc:79-93).
+        self.materialized_pyobjs: Dict[int, Any] = {}
+
+    def detach_deps(self) -> None:
+        """Free graph memory incrementally after replay (deferred_init.cc:521-523)."""
+        self.deps = []
+
+    def __repr__(self):
+        return f"OpNode({self.op_nr}: {self.op.name})"
+
+
+class Tape:
+    """The active recording — owns the op counter and the alias index.
+
+    The reference keeps a thread-local ``op_nr`` counter
+    (deferred_init.cc:671) and discovers in-place dependents by walking
+    per-node weak back-edges (getLastInPlaceOpNode, deferred_init.cc:540-578).
+    Here the tape keeps a storage→[(op_nr, node)] writer index used at record
+    time to install those back-edges; materialization then navigates the node
+    graph alone, so it works long after the tape is gone.
+    """
+
+    def __init__(self):
+        self.op_counter = 0
+        # storage key -> list of (op_nr, weakref to node) that WROTE it
+        self.writers: Dict[int, List[Tuple[int, weakref.ref]]] = {}
+
+    def next_op_nr(self) -> int:
+        nr = self.op_counter
+        self.op_counter += 1
+        return nr
+
+    def note_write(self, storage_key: int, node: OpNode) -> None:
+        entries = self.writers.setdefault(storage_key, [])
+        # Link every earlier toucher of this storage to the new writer —
+        # materialization (possibly long after this tape is gone) navigates
+        # the node graph alone, like the reference's dependents_ walk
+        # (deferred_init.cc:540-578).
+        for _, ref in entries:
+            prev = ref()
+            if prev is not None and prev is not node:
+                prev.dependents.append(node)
+        entries.append((node.op_nr, weakref.ref(node)))
+
+
+def current_tape() -> Optional[Tape]:
+    return getattr(_tls, "tape", None)
+
+
+def push_tape() -> Tape:
+    tape = Tape()
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(tape)
+    _tls.tape = tape
+    return tape
+
+
+def pop_tape() -> None:
+    stack = _tls.stack
+    stack.pop()
+    _tls.tape = stack[-1] if stack else None
+
+
+def _torch_allocatable(device: torch.device) -> bool:
+    if device.type in ("cpu", "meta"):
+        return True
+    if device.type == "cuda":
+        return torch.cuda.is_available()
+    if device.type == "mps":
+        return torch.backends.mps.is_available()
+    return False
+
+
+def _storage_key(meta: torch.Tensor) -> int:
+    return meta.untyped_storage()._cdata
+
+
+def _mutated_arg_indices(func) -> List[int]:
+    """Positional-arg indices the op writes to, from the schema alias info."""
+    out = []
+    try:
+        schema = func._schema
+    except AttributeError:
+        return out
+    for i, arg in enumerate(schema.arguments):
+        if arg.alias_info is not None and arg.alias_info.is_write:
+            out.append(i)
+    return out
+
+
+def record_op(
+    tape: Tape,
+    func,
+    args: tuple,
+    kwargs: dict,
+    fake_outputs: list,
+    *,
+    is_fake: Callable[[Any], bool],
+    get_record: Callable[[Any], Optional[TensorRecord]],
+    set_record: Callable[[Any, TensorRecord], None],
+) -> OpNode:
+    """Record one op — analog of ``recordOp`` (deferred_init.cc:673-710).
+
+    ``fake_outputs`` are the fake tensors the op produced (or mutated).
+    Fake args become dependency edges and are *dropped* from the preserved
+    stack (replaced by :class:`OutputRef`), breaking reference cycles the
+    same way attachDependencies does (deferred_init.cc:463-495).  Real
+    tensors are kept with version guards; all other leaves are deep-copied
+    (copyStack, deferred_init.cc:69-100).
+    """
+    deps: List[OpNode] = []
+    guards: List[ExternalTensorGuard] = []
+
+    def preserve(a):
+        if is_fake(a):
+            rec = get_record(a)
+            if rec is None:
+                raise RuntimeError(
+                    "Cannot record an operation on a fake tensor that was "
+                    "created outside of a deferred-init context."
+                )
+            deps.append(rec.node)
+            return OutputRef(rec.node, rec.index)
+        if isinstance(a, torch.Tensor):
+            guards.append(ExternalTensorGuard(a, a._version))
+            return a
+        if isinstance(a, (int, float, bool, str, bytes, complex, type(None),
+                          torch.dtype, torch.device, torch.layout,
+                          torch.memory_format, torch.Generator)):
+            return a
+        # Containers are flattened by tree_map; anything else must be
+        # deep-copyable — the immutability validation analog
+        # (deferred_init.cc:227-253).
+        try:
+            return copy.deepcopy(a)
+        except Exception as e:  # pragma: no cover
+            raise RuntimeError(
+                f"Cannot record op '{func}': argument of type "
+                f"{type(a).__name__} is not preservable."
+            ) from e
+
+    p_args, p_kwargs = pytree.tree_map(preserve, (tuple(args), dict(kwargs)))
+
+    op = Op(
+        name=str(func),
+        func=func,
+        args=p_args,
+        kwargs=p_kwargs,
+        grad_enabled=torch.is_grad_enabled(),
+        guards=guards,
+    )
+    node = OpNode(tape.next_op_nr(), op, deps)
+    node.num_outputs = len(fake_outputs)
+
+    # Output storages for aliasing checks (recordStorages,
+    # deferred_init.cc:416-428) via the meta shadows.
+    for out in fake_outputs:
+        if out is not None:
+            node.out_storages.append(_storage_key(out._meta))
+            node.pinned_storages.append(out._meta.untyped_storage())
+
+    # Storages the op WROTE: schema-mutated args + all outputs (an output
+    # freshly created or aliasing a mutated arg both count as written).
+    mutated = set(_mutated_arg_indices(func))
+    for i, a in enumerate(args):
+        if i in mutated and is_fake(a):
+            node.write_storages.append(_storage_key(a._meta))
+            node.pinned_storages.append(a._meta.untyped_storage())
+    node.write_storages.extend(node.out_storages)
+    for key in set(node.write_storages):
+        tape.note_write(key, node)
+
+    # Point each fake output's record at this node (deferred_init.cc:683-710).
+    for idx, out in enumerate(fake_outputs):
+        if out is not None:
+            set_record(out, TensorRecord(node, idx))
+    return node
+
+
+def build_call_stack(target: OpNode) -> List[OpNode]:
+    """Build the chronological replay schedule for ``target``.
+
+    Analog of ``OpNode::materialize``'s buildCallStack
+    (deferred_init.cc:529-621): find the last in-place op touching any
+    storage aliased with the target's outputs (the *horizon*,
+    getLastInPlaceOpNode deferred_init.cc:540-578), then collect the
+    transitive dependency closure plus in-place dependents within the
+    horizon (collectCallStack, deferred_init.cc:580-621), sorted by
+    ``op_nr``.  Self-contained on the node graph — no live tape needed.
+    """
+    horizon = target.op_nr
+    for d in target.dependents:
+        if d.op_nr > horizon:
+            horizon = d.op_nr
+    result: Dict[int, OpNode] = {}
+    work: List[OpNode] = [target]
+    while work:
+        node = work.pop()
+        if node.op_nr in result:
+            continue
+        result[node.op_nr] = node
+        work.extend(node.deps)
+        for ref in pytree.tree_iter((node.op.args, node.op.kwargs)):
+            if isinstance(ref, OutputRef):
+                work.append(ref.node)
+        for d in node.dependents:
+            if d.op_nr <= horizon:
+                work.append(d)
+    return [result[nr] for nr in sorted(result)]
+
+
+def replay_node(node: OpNode) -> List[Any]:
+    """Replay one node for real — analog of ``Op::materialize``
+    (deferred_init.cc:255-271).  Idempotent: runs once and caches outputs."""
+    op = node.op
+    if op.replayed:
+        return op.outputs  # type: ignore[return-value]
+    for guard in op.guards:
+        guard.check()
+
+    def resolve(a):
+        if isinstance(a, OutputRef):
+            outs = replay_node(a.node)
+            return outs[a.index]
+        return a
+
+    r_args, r_kwargs = pytree.tree_map(resolve, (op.args, op.kwargs))
+    recorded_device = r_kwargs.get("device")
+    if recorded_device is not None:
+        override = getattr(_tls, "device_override", None)
+        if override is not None:
+            # Replayed factory ops carry their recorded (claimed) device; a
+            # replay-time override redirects them (e.g. a fake "tpu:0" claim
+            # replayed on host CPU before transfer, or test-time redirection).
+            r_kwargs["device"] = override
+        elif not _torch_allocatable(torch.device(recorded_device)):
+            # Claimed devices torch has no backend for (tpu/xla, or cuda on a
+            # CUDA-less host) replay on host CPU by default; the JAX
+            # materializer is the native route onto the actual device.
+            r_kwargs["device"] = torch.device("cpu")
+    with torch.set_grad_enabled(op.grad_enabled):
+        out = op.func(*r_args, **r_kwargs)
+    if isinstance(out, (tuple, list)):
+        outputs = list(out)
+    else:
+        outputs = [out]
+    op.outputs = outputs
+    op.replayed = True
+    node.detach_deps()
+    return outputs

@@ -1,0 +1,263 @@
+"""Deferred module initialization: record construction, inspect, materialize.
+
+Rebuild of the reference's deferred-init feature
+(/root/reference/src/cc/torchdistx/deferred_init.cc, src/python/torchdistx/
+deferred_init.py).  ``deferred_init(module_fn, *args, **kwargs)`` constructs a
+module whose parameters/buffers are fake while recording every operation into
+the op tape (:mod:`torchdistx_tpu._tape`); ``materialize_tensor`` /
+``materialize_module`` replay the tape to instantiate real tensors.  The
+load-bearing use case is shard-then-materialize: inspect the full architecture
+with zero allocation, decide a sharding plan, then materialize each shard
+directly on its device — on TPU via :mod:`torchdistx_tpu.materialize`, which
+replays the tape as sharded ``jax.Array`` leaves on a mesh.
+
+Interception design: the reference registers a pre-autograd ``DeferredInit``
+dispatch-key fallback (deferred_init.cc:879-882) that deep-copies each call
+frame, redispatches with the ``Fake`` key added, and records the op iff a fake
+tensor flows in or out (deferred_init.cc:767-797); ``nn.Parameter``'s
+non-dispatcher ``Tensor.data`` accesses are caught by swapping autograd's
+global ``VariableHooksInterface`` for a recording proxy
+(deferred_init.cc:888-1127).  Here a ``TorchDispatchMode`` plays the dispatch
+fallback, and no hooks proxy is needed at all: with wrapper-subclass fakes,
+``nn.Parameter(fake)`` routes through ``aten::detach`` which IS dispatched —
+the hooks machinery collapses into the ordinary record path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Optional
+
+import torch
+import torch.nn as nn
+import torch.utils._pytree as pytree
+from torch.utils._python_dispatch import TorchDispatchMode
+
+from . import _tape
+from ._tape import OpNode, Tape, TensorRecord  # noqa: F401 (public graph types)
+from .fake import (
+    FakeTensor,
+    _fake_handler,
+    _ensure_tpu_device_registered,
+    _suppress_cuda_lazy_init,
+)
+
+__all__ = [
+    "deferred_init",
+    "materialize_tensor",
+    "materialize_module",
+    "is_deferred",
+]
+
+_SLOT = "deferred_init"
+_tls = threading.local()
+
+# Terminal ops force materialization of their args and then run for real —
+# the analog of the reference's terminal-op set (deferred_init.cc:812-814,
+# `aten::item`).  `_local_scalar_dense` is what `.item()` lowers to at this
+# seam; `aten::equal` also requires real data.
+_TERMINAL_OPS = {
+    "aten::item",
+    "aten::_local_scalar_dense",
+    "aten::equal",
+    "aten::allclose",
+}
+
+
+def _get_record(fake: FakeTensor) -> Optional[TensorRecord]:
+    return fake._slots.get(_SLOT)
+
+
+def _set_record(fake: FakeTensor, record: TensorRecord) -> None:
+    fake._slots[_SLOT] = record
+
+
+def is_deferred(tensor: torch.Tensor) -> bool:
+    """True if ``tensor`` is fake and carries a deferred-init record."""
+    return isinstance(tensor, FakeTensor) and _get_record(tensor) is not None
+
+
+class _DeferredInitMode(TorchDispatchMode):
+    """Record/redispatch mode — analog of ``DeferredInitHandler::run``
+    (deferred_init.cc:767-797)."""
+
+    def __init__(self, tape: Tape, default_device: Optional[torch.device]):
+        super().__init__()
+        self.tape = tape
+        self.default_device = default_device
+
+    def __torch_dispatch__(self, func, types, args=(), kwargs=None):
+        kwargs = kwargs or {}
+        if func.name() in _TERMINAL_OPS:
+            # Force-materialize fake args, then run for real
+            # (deferred_init.cc:774-779).
+            def mat(a):
+                if isinstance(a, FakeTensor):
+                    return materialize_tensor(a)
+                return a
+
+            r_args, r_kwargs = pytree.tree_map(mat, (tuple(args), dict(kwargs)))
+            return func(*r_args, **r_kwargs)
+
+        # Redispatch through the fake handler so outputs come out fake
+        # (the `redispatchToFake` step, deferred_init.cc:830-835).
+        out = _fake_handler(
+            func, args, kwargs, default_device=self.default_device
+        )
+
+        flat_in = pytree.arg_tree_leaves(*args, **kwargs)
+        flat_out = pytree.tree_leaves(out)
+        fake_outputs = [o for o in flat_out if isinstance(o, FakeTensor)]
+        has_fake_arg = any(isinstance(a, FakeTensor) for a in flat_in)
+        if has_fake_arg or fake_outputs:
+            # Record iff a fake flows in or out (deferred_init.cc:780-796).
+            _tape.record_op(
+                self.tape,
+                func,
+                args,
+                kwargs,
+                fake_outputs,
+                is_fake=lambda a: isinstance(a, FakeTensor),
+                get_record=_get_record,
+                set_record=_set_record,
+            )
+        return out
+
+
+@contextlib.contextmanager
+def _deferred_init_context(device: Optional[Any] = None):
+    """Enter/leave the deferred-init recording context — analog of
+    enterDeferredInit/leaveDeferredInit (deferred_init.cc:1138-1160)."""
+    if device is not None:
+        device = torch.device(device)
+        if device.type == "tpu":
+            _ensure_tpu_device_registered()
+    tape = _tape.push_tape()
+    mode = _DeferredInitMode(tape, default_device=device)
+    level = getattr(_tls, "level", 0)
+    _tls.level = level + 1
+    try:
+        with contextlib.ExitStack() as stack:
+            # Same CUDA lazy-init suppression as fake_mode: factory bindings
+            # would otherwise fail for claimed "cuda" devices on CUDA-less
+            # hosts before dispatch reaches the mode (_C/fake.cc:18-36).
+            stack.enter_context(_suppress_cuda_lazy_init())
+            if device is not None:
+                # Same DeviceContext routing as fake_mode: factories arrive
+                # already carrying the claimed default device.
+                stack.enter_context(torch.device(device))
+            stack.enter_context(mode)
+            yield tape
+    finally:
+        _tls.level = level
+        _tape.pop_tape()
+
+
+def deferred_init(module_fn: Callable[..., Any], *args, **kwargs):
+    """Construct ``module_fn(*args, **kwargs)`` with fake, recorded tensors.
+
+    Analog of the reference's ``deferred_init`` (deferred_init.py:19-44).
+    The optional keyword-only ``device_`` sets the claimed device for the
+    module's factory calls (e.g. ``device_="tpu"`` to fake a model "on TPU");
+    by default factories claim the device they ask for, else CPU.
+    """
+    device = kwargs.pop("device_", None)
+    with _deferred_init_context(device=device):
+        return module_fn(*args, **kwargs)
+
+
+def _wrap_materialized(fake: FakeTensor, node: OpNode, index: int) -> torch.Tensor:
+    """Apply the identity/class-preservation contract.
+
+    Analog of materializeVariable (_C/deferred_init.cc:60-94): materializing
+    the same (node, output) twice returns the *same* Python object, and a
+    fake ``nn.Parameter`` materializes as an ``nn.Parameter``.
+    """
+    cached = node.materialized_pyobjs.get(index)
+    if cached is not None:
+        return cached
+    real = node.op.outputs[index]
+    # Re-apply requires_grad post-replay: `requires_grad_()` is not
+    # dispatcher-visible, so like the reference we restore it from the fake
+    # (deferred_init.cc:721-725).
+    if isinstance(real, torch.Tensor):
+        if real.is_leaf and real.requires_grad != fake.requires_grad:
+            real.requires_grad_(fake.requires_grad)
+        if isinstance(fake, nn.Parameter) or getattr(fake, "_is_param", False):
+            if not isinstance(real, nn.Parameter):
+                real = nn.Parameter(real, requires_grad=fake.requires_grad)
+    node.materialized_pyobjs[index] = real
+    return real
+
+
+@contextlib.contextmanager
+def _replay_device_override(device: Optional[Any]):
+    if device is None:
+        yield
+        return
+    target = torch.device(device)
+    prev = getattr(_tape._tls, "device_override", None)
+    _tape._tls.device_override = target
+    try:
+        yield
+    finally:
+        _tape._tls.device_override = prev
+
+
+def materialize_tensor(
+    tensor: torch.Tensor, *, device: Optional[Any] = None
+) -> torch.Tensor:
+    """Materialize a fake tensor by replaying its recorded subgraph.
+
+    Analog of the reference's ``materialize_tensor`` (deferred_init.py:47-59,
+    deferred_init.cc:1162-1168,712-728).  No-op for real tensors and for
+    fakes with no record.  ``device`` optionally redirects replayed factory
+    ops to a different real device (needed when the fake claims a device,
+    like ``tpu:0``, that torch cannot allocate on; the JAX path in
+    :mod:`torchdistx_tpu.materialize` is the native route for those).
+    """
+    if not isinstance(tensor, FakeTensor):
+        return tensor
+    record = _get_record(tensor)
+    if record is None:
+        return tensor
+    call_stack = _tape.build_call_stack(record.node)
+    # Replay with recording/fake modes disabled: materialization may run
+    # inside the deferred-init context (terminal ops do, deferred_init.cc:768
+    # runs under the NoDeferredInit guard) and must execute for real.
+    with _replay_device_override(device), torch.utils._python_dispatch._disable_current_modes():
+        for node in call_stack:
+            _tape.replay_node(node)
+    return _wrap_materialized(tensor, record.node, record.index)
+
+
+def materialize_module(
+    module: nn.Module,
+    *,
+    buffers_only: bool = False,
+    check_fn: Optional[Callable[[nn.Module], bool]] = None,
+    device: Optional[Any] = None,
+) -> nn.Module:
+    """Materialize all fake parameters/buffers of ``module`` in place.
+
+    Analog of the reference's ``materialize_module`` (deferred_init.py:62-99):
+    depth-first over ``module.children()``, rewriting ``module._parameters``
+    and ``module._buffers`` in place; ``buffers_only`` skips parameters;
+    ``check_fn`` gates whole submodules (the FSDP shard-then-materialize
+    hook).  Returns ``module``.
+    """
+    for child in module.children():
+        materialize_module(
+            child, buffers_only=buffers_only, check_fn=check_fn, device=device
+        )
+    if check_fn is not None and not check_fn(module):
+        return module
+    if not buffers_only:
+        for key, param in module._parameters.items():
+            if param is not None and is_deferred(param):
+                module._parameters[key] = materialize_tensor(param, device=device)
+    for key, buf in module._buffers.items():
+        if buf is not None and is_deferred(buf):
+            module._buffers[key] = materialize_tensor(buf, device=device)
+    return module

@@ -1,0 +1,325 @@
+"""Fake tensors: metadata-only tensors that claim a real (possibly absent) device.
+
+TPU-native rebuild of the reference's fake-tensor feature
+(/root/reference/src/cc/torchdistx/fake.cc, src/python/torchdistx/fake.py).
+
+Design
+------
+The reference implements a C++ ``TensorImpl`` subclass with no storage
+(``FakeTensorImpl``, fake.cc:73-245) plus a boxed dispatch-key fallback
+(``FakeHandler``, fake.cc:256-548) that diverts every op to the *meta* backend
+and converts the meta results back into fake tensors, and a device-guard spoof
+so fake CUDA tensors can exist on CUDA-less hosts (fake.cc:554-586).
+
+Here the same capability is built on the idiomatic seams this stack offers:
+
+* ``torch.Tensor._make_wrapper_subclass`` creates a storage-less tensor that
+  *reports* an arbitrary device — the ``FakeTensorImpl`` analog.  Each fake
+  carries a shadow **meta** tensor used for all shape/stride/dtype dispatch
+  (the analog of fake.cc:69-72's meta shadow).
+* ``__torch_dispatch__`` (subclass + mode) is the interception seam — the
+  analog of the boxed ``Fake``-key fallback.  Ops on fakes run on the meta
+  shadows; factory ops under ``fake_mode()`` are redirected to the meta
+  backend and their outputs wrapped as fakes claiming the requested device
+  (fake.cc:419-432's output-device rules).
+* The device-guard spoof becomes trivial: claiming ``cuda``/``tpu`` devices
+  requires no guard because the wrapper subclass never touches a backend.
+  ``tpu`` devices are made nameable by renaming torch's ``privateuse1``
+  backend — the analog of installing ``NoOpDeviceGuardImpl`` (fake.cc:556-572):
+  we "lie to PyTorch" in the same way, just through a supported hook.
+
+The TPU story: a model faked on ``tpu:k`` devices is later materialized by the
+JAX backend (:mod:`torchdistx_tpu.materialize`) directly as sharded
+``jax.Array`` leaves on a ``jax.sharding.Mesh`` — no host round-trip.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+import torch
+import torch.utils._pytree as pytree
+from torch.utils._mode_utils import no_dispatch
+from torch.utils._python_dispatch import TorchDispatchMode
+
+__all__ = [
+    "FakeTensor",
+    "fake_mode",
+    "is_fake",
+    "meta_like",
+    "current_fake_mode",
+]
+
+_tls = threading.local()
+
+
+def _ensure_tpu_device_registered() -> None:
+    """Make ``torch.device("tpu")`` nameable on hosts with no TPU torch backend.
+
+    Analog of the reference's fake-CUDA device-guard spoof
+    (fake.cc:556-572): it installs a no-op device guard so PyTorch accepts
+    CUDA tensors on CUDA-less hosts; we rename the ``privateuse1`` backend so
+    PyTorch accepts ``tpu`` as a device string.  No kernels are registered —
+    fake tensors never dispatch to their claimed device.
+    """
+    try:
+        torch.utils.rename_privateuse1_backend("tpu")
+    except (RuntimeError, AttributeError):
+        # Already renamed (possibly by us) or unsupported; if "tpu" parses we
+        # are fine either way.
+        pass
+    if getattr(torch, "tpu", None) is None:
+        # Factory bindings lazy-init the claimed device's backend module; a
+        # no-op module is the exact spirit of the reference's
+        # NoOpDeviceGuardImpl ("we basically lie to PyTorch", fake.cc:556-572).
+        import types
+
+        spoof = types.ModuleType("torch.tpu")
+        spoof.is_available = lambda: True
+        spoof.is_initialized = lambda: True
+        spoof._lazy_init = lambda: None
+        spoof.device_count = lambda: 0
+        spoof.current_device = lambda: 0
+        spoof._is_in_bad_fork = lambda: False
+        spoof.manual_seed_all = lambda seed: None
+        try:
+            torch._register_device_module("tpu", spoof)
+        except RuntimeError:
+            pass
+
+
+# The rename must precede any `torch.device("tpu")` string parse, which
+# happens inside factory bindings before our handler runs — register at
+# import, like the reference registers its dispatch fallbacks at library
+# load (fake.cc:546-548, §3.5 of SURVEY.md).
+_ensure_tpu_device_registered()
+
+
+@contextlib.contextmanager
+def _suppress_cuda_lazy_init():
+    """Suppress CUDA lazy initialization while a fake mode is active.
+
+    Analog of the reference's ``set_requires_cuda_init(false)``
+    (_C/fake.cc:18-36): factory bindings eagerly call
+    ``torch.cuda._lazy_init`` for ``device="cuda"`` *before* dispatch
+    reaches our interception seam, which would fail on CUDA-less hosts.
+    The op itself never touches CUDA — the mode diverts it to meta.
+    """
+    if torch.cuda.is_available():
+        yield
+        return
+    prev = torch.cuda._lazy_init
+    torch.cuda._lazy_init = lambda: None
+    try:
+        yield
+    finally:
+        torch.cuda._lazy_init = prev
+
+
+class FakeTensor(torch.Tensor):
+    """A tensor with no storage that claims to live on ``fake_device``.
+
+    Analog of ``FakeTensorImpl`` (fake.cc:73-245): holds a shadow meta tensor
+    (``_meta``) used for dispatch, reports the claimed device, and carries a
+    per-subsystem side-data dict ``_slots`` — the analog of the reference's
+    per-dispatch-key ``dispatch_data`` map (fake.cc:118-121) that deferred
+    init uses to attach its graph record.
+    """
+
+    _meta: torch.Tensor
+    fake_device: torch.device
+    _slots: Dict[str, Any]
+
+    @staticmethod
+    def __new__(cls, meta: torch.Tensor, fake_device: torch.device):
+        assert meta.device.type == "meta", "FakeTensor shadow must be a meta tensor"
+        r = torch.Tensor._make_wrapper_subclass(  # type: ignore[attr-defined]
+            cls,
+            meta.shape,
+            strides=meta.stride(),
+            storage_offset=meta.storage_offset(),
+            dtype=meta.dtype,
+            layout=meta.layout,
+            device=fake_device,
+            requires_grad=meta.requires_grad,
+        )
+        r._meta = meta
+        r.fake_device = fake_device
+        r._slots = {}
+        return r
+
+    # Like the reference's repr patch (fake.py:15-40) but scoped to the
+    # subclass instead of monkey-patching torch.Tensor.__repr__ globally.
+    def __repr__(self, *, tensor_contents=None):  # noqa: D105
+        grad = ", requires_grad=True" if self.requires_grad else ""
+        return (
+            f"tensor(..., device='{self.fake_device}', size={tuple(self.shape)}, "
+            f"dtype={self.dtype}{grad}, fake=True)"
+        )
+
+    __str__ = __repr__
+
+    @classmethod
+    def __torch_dispatch__(cls, func, types, args=(), kwargs=None):
+        # Ops touching fake tensors outside of any active mode still hit this
+        # seam — the analog of the Fake dispatch key living in the *tensor's*
+        # key set (fake.cc:129-150), not only in TLS.
+        return _fake_handler(func, args, kwargs or {}, default_device=None)
+
+
+class _FakeMode(TorchDispatchMode):
+    """Catch-all interception while ``fake_mode()`` is active.
+
+    Analog of ``enterFakeMode`` TLS-including the ``Fake`` key
+    (fake.cc:595-605): with the mode pushed, *factory* ops (no tensor args)
+    are also intercepted and produce fakes.
+    """
+
+    def __init__(self, default_device: Optional[torch.device] = None):
+        super().__init__()
+        self.default_device = default_device
+
+    def __torch_dispatch__(self, func, types, args=(), kwargs=None):
+        return _fake_handler(
+            func, args, kwargs or {}, default_device=self.default_device
+        )
+
+
+def _tensor_to_meta(t: torch.Tensor) -> torch.Tensor:
+    # Real (non-fake) tensor mixed into a faked op: use its metadata only.
+    with no_dispatch():
+        return torch.empty_strided(
+            t.shape, t.stride(), dtype=t.dtype, device="meta"
+        ).requires_grad_(t.requires_grad and t.is_leaf)
+
+
+def _fake_handler(func, args, kwargs, *, default_device: Optional[torch.device]):
+    """The per-op handler — analog of ``FakeHandler::run`` (fake.cc:318-536).
+
+    Device rules follow fake.cc:419-432: explicit ``device`` argument wins,
+    else the first fake argument's claimed device, else the mode's default
+    claimed device (for factories), else the op runs for real untouched
+    (fake.cc:534-536).
+    """
+    flat_args = pytree.arg_tree_leaves(*args, **kwargs)
+    fakes = [a for a in flat_args if isinstance(a, FakeTensor)]
+
+    device_kwarg = kwargs.get("device")
+    if device_kwarg is not None:
+        out_device = torch.device(device_kwarg)
+        if out_device.type == "tpu":
+            _ensure_tpu_device_registered()
+    elif fakes:
+        out_device = fakes[0].fake_device
+        for f in fakes[1:]:
+            if f.fake_device != out_device:
+                raise RuntimeError(
+                    f"Cannot run '{func}' with fake tensors on mixed devices "
+                    f"({out_device} and {f.fake_device})."
+                )
+    elif default_device is not None:
+        out_device = torch.device(default_device)
+    else:
+        out_device = None
+
+    if out_device is None and not fakes:
+        # Pure real-tensor op under the mode: forward untouched
+        # (fake.cc:534-536).
+        return func(*args, **kwargs)
+    if out_device is None:
+        out_device = torch.device("cpu")
+    if out_device.type == "meta":
+        # User explicitly asked for meta — not our business to wrap.
+        return func(*args, **kwargs)
+
+    # Swap fake args for their meta shadows (fake.cc:434-460), keeping an
+    # identity map so in-place ops hand back the original fake wrapper — the
+    # analog of the ``meta_to_fake_`` map (fake.cc:507-523).
+    meta_to_fake: Dict[int, FakeTensor] = {}
+
+    def unwrap(a):
+        if isinstance(a, FakeTensor):
+            meta_to_fake[id(a._meta)] = a
+            return a._meta
+        if isinstance(a, torch.Tensor) and a.device.type != "meta":
+            return _tensor_to_meta(a)
+        return a
+
+    u_args, u_kwargs = pytree.tree_map(unwrap, (tuple(args), dict(kwargs)))
+    if u_kwargs.get("device") is not None:
+        # Redispatch the factory to the meta backend (fake.cc:466-489).
+        u_kwargs["device"] = torch.device("meta")
+
+    try:
+        out = func(*u_args, **u_kwargs)
+    except NotImplementedError as e:
+        # Friendly error like fake.cc:484-486.
+        raise RuntimeError(
+            f"The operator '{func}' has no meta-backend support, so it cannot "
+            f"be run with fake tensors."
+        ) from e
+
+    def wrap(o):
+        if isinstance(o, torch.Tensor) and o.device.type == "meta":
+            existing = meta_to_fake.get(id(o))
+            if existing is not None:
+                return existing
+            return FakeTensor(o, out_device)
+        return o
+
+    return pytree.tree_map(wrap, out)
+
+
+@contextlib.contextmanager
+def fake_mode(*, fake_cuda: bool = False, device: Optional[Any] = None):
+    """Context manager within which newly constructed tensors are fake.
+
+    Analog of the reference's ``fake_mode`` (fake.py:44-56).  ``fake_cuda``
+    is honored for API parity (it makes ``device="cuda"`` claims legal on
+    CUDA-less hosts, which the wrapper-subclass design gives us for free).
+    ``device`` optionally sets the claimed device for factory calls that do
+    not pass one — e.g. ``fake_mode(device="tpu")`` builds a whole model
+    "on TPU" with zero allocation anywhere.
+    """
+    if device is not None:
+        device = torch.device(device)
+        if device.type == "tpu":
+            _ensure_tpu_device_registered()
+    mode = _FakeMode(default_device=device)
+    mode_stack = getattr(_tls, "mode_stack", None)
+    if mode_stack is None:
+        mode_stack = _tls.mode_stack = []
+    mode_stack.append(mode)
+    try:
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(_suppress_cuda_lazy_init())
+            if device is not None:
+                # Route the claimed default through torch's own DeviceContext
+                # so factory calls arrive at the handler already carrying it
+                # (the binding otherwise fills in `cpu` before dispatch).
+                stack.enter_context(torch.device(device))
+            stack.enter_context(mode)
+            yield mode
+    finally:
+        mode_stack.pop()
+
+
+def current_fake_mode() -> Optional[_FakeMode]:
+    stack = getattr(_tls, "mode_stack", None)
+    return stack[-1] if stack else None
+
+
+def is_fake(tensor: torch.Tensor) -> bool:
+    """True if ``tensor`` is fake — analog of fake.py:59-66 / fake.cc:625."""
+    return isinstance(tensor, FakeTensor)
+
+
+def meta_like(fake: torch.Tensor) -> torch.Tensor:
+    """Detached meta clone of a fake tensor — analog of fake.py:69-82,
+    fake.cc:640-648 (``FakeTensor::toMeta``)."""
+    if not is_fake(fake):
+        raise ValueError("`fake` is not a fake tensor.")
+    with no_dispatch():
+        return fake._meta.detach().clone()
