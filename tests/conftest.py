@@ -1,11 +1,21 @@
 """Test configuration.
 
 Distributed tests run on a virtual multi-device CPU mesh — the JAX analog of
-the reference's multi-process FSDPTest harness (see SURVEY.md §4): set the
-platform flags BEFORE jax is imported anywhere.
+the reference's multi-process FSDPTest harness (see SURVEY.md §4).
+
+The environment's sitecustomize pins ``JAX_PLATFORMS=axon`` (the tunneled
+real TPU); tests must run on virtual CPU devices, so the platform is forced
+back to cpu via ``jax.config`` (env vars alone are overwritten by the
+sitecustomize hook).
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", "")
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -230,3 +230,44 @@ def test_storage_key_reuse_no_false_aliasing():
     real = materialize_tensor(keep)
     assert torch.equal(real, torch.ones(4))
     assert n_deps_before == 0
+
+
+def test_real_ops_stay_real_under_default_device():
+    # Regression: a mode-level default device must not hijack ops on real
+    # tensors onto meta (their data would be silently discarded).
+    real = torch.arange(6.0)
+    with fake.fake_mode(device="tpu"):
+        out = real * 2
+    assert not fake.is_fake(out)
+    assert torch.equal(out, torch.arange(6.0) * 2)
+
+
+def test_cross_tape_module_materialization():
+    # Regression: op_nr is globally unique, so a module assembled from two
+    # deferred_init calls materializes correctly.
+    m1 = deferred_init.deferred_init(nn.Linear, 4, 4)
+    m2 = deferred_init.deferred_init(nn.Linear, 4, 4)
+    seq = nn.Sequential(m1, m2)
+    materialize_module(seq)
+    assert not fake.is_fake(seq[0].weight)
+    assert not fake.is_fake(seq[1].weight)
+    # Different tapes must not share values (distinct op numbering).
+    assert not torch.equal(seq[0].weight, seq[1].weight)
+
+
+def test_materialize_module_order_independent_aliasing():
+    # Regression: write-after-read through an alias — module traversal order
+    # must not leak a later in-place op into an earlier-recorded read.
+    class M(nn.Module):
+        pass
+
+    with deferred_init._deferred_init_context():
+        t = torch.zeros(4)
+        u = t + 1          # recorded BEFORE the mutation
+        t.add_(5)
+        mod = M()
+        mod.t = nn.Parameter(t)   # registered first -> materialized first
+        mod.u = nn.Parameter(u)
+    materialize_module(mod)
+    assert torch.equal(mod.t.detach(), torch.full((4,), 5.0))
+    assert torch.equal(mod.u.detach(), torch.ones(4))

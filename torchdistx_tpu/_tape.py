@@ -16,17 +16,16 @@ Differences from the reference, by design:
   tensors have real storage identity but no data), which is exactly the
   role the reference's output-storage sets play (deferred_init.cc:416-428).
 * Replay caching is per-node (``Op::materialize`` runs once,
-  deferred_init.cc:255-271) and dependency edges are dropped after replay to
-  free the graph incrementally (deferred_init.cc:521-523).
-
-A C++ implementation of the graph core (node table, alias index, horizon
-search, closure building) lives in ``csrc/tape_core.cc`` and is used when
-built; this module is the reference semantics and the fallback.
+  deferred_init.cc:255-271).  Caches mutate in place on in-place replays,
+  exactly like the reference's cached outputs; see materialize.py for the
+  union-replay discipline that keeps multi-target materialization
+  order-consistent.
 """
 
 from __future__ import annotations
 
 import copy
+import itertools
 import threading
 import weakref
 from dataclasses import dataclass
@@ -36,6 +35,12 @@ import torch
 import torch.utils._pytree as pytree
 
 _tls = threading.local()
+
+# Process-wide chronological op counter (the reference's is thread-local,
+# deferred_init.cc:671).  Global so that op_nr is unique across tapes: a
+# module may be assembled from several deferred_init calls, and replay
+# caches / PRNG streams are keyed by op_nr.
+_op_counter = itertools.count()
 
 
 class OutputRef:
@@ -129,21 +134,23 @@ class OpNode:
     __slots__ = (
         "op_nr",
         "op",
-        "deps",
         "dependents",
         "out_storages",
+        "out_metas",
         "write_storages",
         "pinned_storages",
+        "mutated_args",
         "num_outputs",
         "materialized_pyobjs",
         "__weakref__",
     )
 
-    def __init__(self, op_nr: int, op: Op, deps: List["OpNode"]):
+    def __init__(self, op_nr: int, op: Op):
         self.op_nr = op_nr
         self.op = op
-        # Strong dependency edges (deferred_init.cc:390).
-        self.deps = deps
+        # Dependency edges live in op.args/kwargs as OutputRef markers (which
+        # hold producer nodes strongly) — the analog of deferred_init.cc:390's
+        # dependency descriptors, without a duplicate edge list.
         # Back-edges to later ops touching any of this node's storages — the
         # analog of the reference's `dependents_` (deferred_init.cc:397).
         # Strong refs (the GC collects cycles) which also provides the
@@ -153,6 +160,13 @@ class OpNode:
         # the view object is dropped.
         self.dependents: List["OpNode"] = []
         self.out_storages: List[int] = []
+        # Meta shadows of the fake outputs: shape/stride/offset/dtype ground
+        # truth for the functional (JAX) replay engine's strided
+        # gather/scatter resolution of views and in-place writes.
+        self.out_metas: List[Optional[torch.Tensor]] = []
+        # Positional-arg indices the op writes (schema alias_info) — which
+        # layouts the functional engine scatters results through.
+        self.mutated_args: List[int] = []
         self.write_storages: List[int] = []
         # Keep the meta storage objects alive: storage keys are raw
         # StorageImpl addresses, and a freed address could be reused by an
@@ -164,10 +178,6 @@ class OpNode:
         # Python-identity cache: materializing the same output twice returns
         # the same object (the reference's pyobj reuse, _C/deferred_init.cc:79-93).
         self.materialized_pyobjs: Dict[int, Any] = {}
-
-    def detach_deps(self) -> None:
-        """Free graph memory incrementally after replay (deferred_init.cc:521-523)."""
-        self.deps = []
 
     def __repr__(self):
         return f"OpNode({self.op_nr}: {self.op.name})"
@@ -185,14 +195,8 @@ class Tape:
     """
 
     def __init__(self):
-        self.op_counter = 0
         # storage key -> list of (op_nr, weakref to node) that WROTE it
         self.writers: Dict[int, List[Tuple[int, weakref.ref]]] = {}
-
-    def next_op_nr(self) -> int:
-        nr = self.op_counter
-        self.op_counter += 1
-        return nr
 
     def note_write(self, storage_key: int, node: OpNode) -> None:
         entries = self.writers.setdefault(storage_key, [])
@@ -274,7 +278,6 @@ def record_op(
     tensors are kept with version guards; all other leaves are deep-copied
     (copyStack, deferred_init.cc:69-100).
     """
-    deps: List[OpNode] = []
     guards: List[ExternalTensorGuard] = []
 
     def preserve(a):
@@ -285,7 +288,6 @@ def record_op(
                     "Cannot record an operation on a fake tensor that was "
                     "created outside of a deferred-init context."
                 )
-            deps.append(rec.node)
             return OutputRef(rec.node, rec.index)
         if isinstance(a, torch.Tensor):
             guards.append(ExternalTensorGuard(a, a._version))
@@ -315,7 +317,7 @@ def record_op(
         grad_enabled=torch.is_grad_enabled(),
         guards=guards,
     )
-    node = OpNode(tape.next_op_nr(), op, deps)
+    node = OpNode(next(_op_counter), op)
     node.num_outputs = len(fake_outputs)
 
     # Output storages for aliasing checks (recordStorages,
@@ -323,11 +325,15 @@ def record_op(
     for out in fake_outputs:
         if out is not None:
             node.out_storages.append(_storage_key(out._meta))
+            node.out_metas.append(out._meta)
             node.pinned_storages.append(out._meta.untyped_storage())
+        else:
+            node.out_metas.append(None)
 
     # Storages the op WROTE: schema-mutated args + all outputs (an output
     # freshly created or aliasing a mutated arg both count as written).
     mutated = set(_mutated_arg_indices(func))
+    node.mutated_args = sorted(mutated)
     for i, a in enumerate(args):
         if i in mutated and is_fake(a):
             node.write_storages.append(_storage_key(a._meta))
@@ -365,7 +371,6 @@ def build_call_stack(target: OpNode) -> List[OpNode]:
         if node.op_nr in result:
             continue
         result[node.op_nr] = node
-        work.extend(node.deps)
         for ref in pytree.tree_iter((node.op.args, node.op.kwargs)):
             if isinstance(ref, OutputRef):
                 work.append(ref.node)
@@ -412,5 +417,4 @@ def replay_node(node: OpNode) -> List[Any]:
         outputs = [out]
     op.outputs = outputs
     op.replayed = True
-    node.detach_deps()
     return outputs

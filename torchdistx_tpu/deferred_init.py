@@ -232,6 +232,26 @@ def materialize_tensor(
     return _wrap_materialized(tensor, record.node, record.index)
 
 
+def _collect_materialization_targets(
+    module: nn.Module,
+    buffers_only: bool,
+    check_fn: Optional[Callable[[nn.Module], bool]],
+    out: list,
+) -> None:
+    # Depth-first over children like the reference (deferred_init.py:91-92).
+    for child in module.children():
+        _collect_materialization_targets(child, buffers_only, check_fn, out)
+    if check_fn is not None and not check_fn(module):
+        return
+    if not buffers_only:
+        for key, param in module._parameters.items():
+            if param is not None and is_deferred(param):
+                out.append((module._parameters, key, param))
+    for key, buf in module._buffers.items():
+        if buf is not None and is_deferred(buf):
+            out.append((module._buffers, key, buf))
+
+
 def materialize_module(
     module: nn.Module,
     *,
@@ -246,18 +266,24 @@ def materialize_module(
     and ``module._buffers`` in place; ``buffers_only`` skips parameters;
     ``check_fn`` gates whole submodules (the FSDP shard-then-materialize
     hook).  Returns ``module``.
+
+    All targets' call stacks are merged and replayed once in global
+    chronological order, so results never depend on module traversal order
+    (an in-place op on a storage shared between two targets must not replay
+    before an earlier-recorded read by the other target).
     """
-    for child in module.children():
-        materialize_module(
-            child, buffers_only=buffers_only, check_fn=check_fn, device=device
-        )
-    if check_fn is not None and not check_fn(module):
-        return module
-    if not buffers_only:
-        for key, param in module._parameters.items():
-            if param is not None and is_deferred(param):
-                module._parameters[key] = materialize_tensor(param, device=device)
-    for key, buf in module._buffers.items():
-        if buf is not None and is_deferred(buf):
-            module._buffers[key] = materialize_tensor(buf, device=device)
+    targets: list = []
+    _collect_materialization_targets(module, buffers_only, check_fn, targets)
+    nodes = {}
+    for _, _, fake in targets:
+        record = _get_record(fake)
+        for node in _tape.build_call_stack(record.node):
+            nodes[node.op_nr] = node
+    with _replay_device_override(device), \
+            torch.utils._python_dispatch._disable_current_modes():
+        for nr in sorted(nodes):
+            _tape.replay_node(nodes[nr])
+    for container, key, fake in targets:
+        record = _get_record(fake)
+        container[key] = _wrap_materialized(fake, record.node, record.index)
     return module

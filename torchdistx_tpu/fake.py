@@ -205,6 +205,7 @@ def _fake_handler(func, args, kwargs, *, default_device: Optional[torch.device])
     """
     flat_args = pytree.arg_tree_leaves(*args, **kwargs)
     fakes = [a for a in flat_args if isinstance(a, FakeTensor)]
+    has_tensor_args = any(isinstance(a, torch.Tensor) for a in flat_args)
 
     device_kwarg = kwargs.get("device")
     if device_kwarg is not None:
@@ -219,7 +220,10 @@ def _fake_handler(func, args, kwargs, *, default_device: Optional[torch.device])
                     f"Cannot run '{func}' with fake tensors on mixed devices "
                     f"({out_device} and {f.fake_device})."
                 )
-    elif default_device is not None:
+    elif default_device is not None and not has_tensor_args:
+        # The mode's default claimed device applies to *factories* only —
+        # an op over real tensors must run for real (fake.cc:534-536), not
+        # be hijacked onto meta with its data discarded.
         out_device = torch.device(default_device)
     else:
         out_device = None

@@ -1,0 +1,447 @@
+"""TPU-native materialization: replay the deferred-init tape as JAX arrays.
+
+This is the reason this framework exists (SURVEY.md §7, BASELINE.md): take a
+module whose parameters are fake + recorded, and instantiate them **directly
+as (sharded) ``jax.Array`` leaves on a TPU mesh** — shard-then-materialize
+with no full-tensor host round-trip.  The reference stops at replaying onto
+real torch devices (deferred_init.cc:505-666); the TPU-native path compiles
+the whole init subgraph into a single ``jit`` whose ``out_shardings`` place
+every parameter shard on its device over ICI, letting XLA's SPMD partitioner
+generate per-shard init (including partitioned RNG) without ever building the
+full tensor anywhere.
+
+Mutation/view semantics on an immutable substrate
+-------------------------------------------------
+The reference replays in-place/view-heavy init code onto *mutable storage*.
+Functionally, each recorded meta **storage** becomes a flat value in an
+environment; tensors are strided windows onto those values:
+
+* reading a tensor = strided gather from its storage buffer
+  (fast path: contiguous whole-storage view = reshape);
+* an in-place op = pure compute + strided scatter back through the written
+  tensor's layout;
+* a view op = no compute at all — its outputs are just layouts, resolved at
+  read time (this subsumes the reference's view keep-alive and aliasing
+  machinery, deferred_init.cc:416-461).
+
+Replay order is the same chronological call-stack the torch path uses
+(_tape.build_call_stack ≈ deferred_init.cc:529-621), so write-after-write and
+read-after-write through any alias resolve exactly as recorded.
+
+RNG: each recorded RNG op draws from ``jax.random.fold_in(key(seed), op_nr)``
+— deterministic, independent of materialization order, and identical across
+hosts, so multi-host sharded materialization is consistent by construction
+(the NCCL-broadcast-init analog: no broadcast needed at all).
+
+Ops with no JAX lowering fall back to torch replay + ``jax.device_put`` with
+the planned sharding (per-tensor, so host RAM stays bounded by the largest
+parameter, not the model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import torch
+import torch.nn as nn
+import torch.utils._pytree as pytree
+
+from . import _tape
+from ._tape import OpNode, OutputRef
+from .deferred_init import _get_record, is_deferred
+from .fake import FakeTensor
+from .ops.aten_jax import LOWERINGS, UnsupportedOpError
+from .utils.dtypes import jnp_dtype_of
+
+__all__ = [
+    "materialize_tensor_jax",
+    "materialize_module_jax",
+]
+
+
+def _is_view_node(node: OpNode) -> bool:
+    """Pure view op: outputs alias inputs, nothing is written.
+
+    Ground truth is the op schema (the reference infers the same from output
+    storages aliasing argument storages, deferred_init.cc:416-461)."""
+    if node.mutated_args:
+        return False
+    try:
+        returns = node.op.func._schema.returns
+    except AttributeError:
+        return False
+    return bool(returns) and all(r.alias_info is not None for r in returns)
+
+
+class _MetaWindow:
+    """Layout of one tensor over its flat storage buffer."""
+
+    __slots__ = (
+        "storage_key",
+        "shape",
+        "strides",
+        "offset",
+        "dtype",
+        "numel",
+        "storage_elems",
+    )
+
+    def __init__(self, meta: torch.Tensor):
+        storage = meta.untyped_storage()
+        self.storage_key = storage._cdata
+        self.shape = tuple(meta.shape)
+        self.strides = tuple(meta.stride())
+        self.offset = meta.storage_offset()
+        self.dtype = meta.dtype
+        self.numel = meta.numel()
+        self.storage_elems = storage.size() // max(meta.element_size(), 1)
+
+    def is_whole_contiguous(self, buffer_len: int) -> bool:
+        if self.offset != 0 or self.numel != buffer_len:
+            return False
+        expected = 1
+        for size, stride in zip(reversed(self.shape), reversed(self.strides)):
+            if size != 1 and stride != expected:
+                return False
+            expected *= size
+        return True
+
+    def flat_indices(self):
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(self.offset)
+        for size, stride in zip(self.shape, self.strides):
+            idx = idx[..., None] + jnp.arange(size) * stride
+        return idx
+
+
+class _FunctionalReplay:
+    """Replays tape nodes as pure JAX computation over storage buffers."""
+
+    def __init__(self, base_key, *, check_guards: bool = True):
+        self.base_key = base_key
+        self.check_guards = check_guards
+        # storage key -> (flat jnp value, element count)
+        self.storages: Dict[int, Any] = {}
+        self.replayed: set = set()
+
+    # -- engine plumbing ----------------------------------------------------
+
+    def read(self, window: _MetaWindow):
+        buf = self.storages[window.storage_key]
+        if window.is_whole_contiguous(buf.shape[0]):
+            return buf.reshape(window.shape)
+        return buf[window.flat_indices()]
+
+    def write(self, window: _MetaWindow, value):
+        import jax.numpy as jnp
+
+        value = jnp.broadcast_to(value, window.shape).astype(
+            jnp_dtype_of(window.dtype)
+        )
+        buf = self.storages.get(window.storage_key)
+        if buf is None:
+            # Fresh storage: a flat buffer covering the whole allocation.
+            buf = jnp.zeros(
+                (window.storage_elems,), dtype=jnp_dtype_of(window.dtype)
+            )
+        if window.is_whole_contiguous(buf.shape[0]):
+            self.storages[window.storage_key] = value.reshape(-1)
+        else:
+            self.storages[window.storage_key] = buf.at[
+                window.flat_indices()
+            ].set(value)
+
+    def value_of_output(self, node: OpNode, index: int):
+        meta = node.out_metas[index]
+        return self.read(_MetaWindow(meta))
+
+    # -- node replay --------------------------------------------------------
+
+    def run_call_stack(self, target: OpNode) -> None:
+        for node in _tape.build_call_stack(target):
+            self.run_node(node)
+
+    def run_node(self, node: OpNode) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if node.op_nr in self.replayed:
+            return
+        self.replayed.add(node.op_nr)
+        if self.check_guards:
+            for guard in node.op.guards:
+                guard.check()
+
+        if _is_view_node(node):
+            # Views are layouts, not computation; ensure the base storage
+            # exists (it must, via dependencies) and move on.
+            return
+
+        def resolve(a):
+            if isinstance(a, OutputRef):
+                meta = a.node.out_metas[a.index]
+                return self.read(_MetaWindow(meta))
+            if isinstance(a, torch.Tensor):
+                return jnp.asarray(a.detach().cpu().numpy())
+            return a
+
+        op = node.op
+        args, kwargs = pytree.tree_map(resolve, (op.args, op.kwargs))
+        name = _packet_name(op.func)
+        fn = LOWERINGS.get(name)
+        if fn is None:
+            raise UnsupportedOpError(
+                f"No JAX lowering for '{name}' (recorded as {op.name})."
+            )
+
+        ctx = _LowerCtx(self, node)
+        out = fn(ctx, *args, **_strip_factory_kwargs(kwargs))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+
+        if node.mutated_args:
+            # In-place: scatter the result back through each written
+            # tensor's layout (writes are visible through every alias).
+            for pos in node.mutated_args:
+                if pos < len(op.args) and isinstance(op.args[pos], OutputRef):
+                    ref = op.args[pos]
+                    meta = ref.node.out_metas[ref.index]
+                    self.write(_MetaWindow(meta), outs[0])
+        # Fresh outputs define their storages.
+        for i, meta in enumerate(node.out_metas):
+            if meta is None or i >= len(outs):
+                continue
+            window = _MetaWindow(meta)
+            if window.storage_key not in self.storages:
+                self.write(window, outs[i])
+
+
+class _LowerCtx:
+    """Per-node context handed to lowerings: PRNG key + output metadata."""
+
+    __slots__ = ("engine", "node")
+
+    def __init__(self, engine: _FunctionalReplay, node: OpNode):
+        self.engine = engine
+        self.node = node
+
+    @property
+    def key(self):
+        import jax
+
+        return jax.random.fold_in(self.engine.base_key, self.node.op_nr)
+
+    def out_meta(self, index: int) -> torch.Tensor:
+        return self.node.out_metas[index]
+
+
+def _packet_name(func) -> str:
+    # e.g. "aten.uniform_.default"
+    return str(func)
+
+
+def _strip_factory_kwargs(kwargs: dict) -> dict:
+    return {
+        k: v
+        for k, v in kwargs.items()
+        if k not in ("device", "layout", "pin_memory", "memory_format",
+                     "non_blocking", "generator")
+    }
+
+
+# ---------------------------------------------------------------------------
+# Public API
+
+
+def _named_fakes(module: nn.Module) -> List[Tuple[str, FakeTensor]]:
+    out = []
+    for name, p in module.named_parameters(remove_duplicate=True):
+        if is_deferred(p):
+            out.append((name, p))
+    for name, b in module.named_buffers(remove_duplicate=True):
+        if is_deferred(b):
+            out.append((name, b))
+    return out
+
+
+def _resolve_spec(plan, name: str, fake: FakeTensor, mesh=None):
+    from jax.sharding import PartitionSpec
+
+    if plan is None:
+        return PartitionSpec()
+    if callable(plan):
+        spec = plan(name, tuple(fake.shape))
+    else:
+        spec = plan.get(name)
+    if spec is None:
+        return PartitionSpec()
+    if mesh is None:
+        return spec
+    # Drop axis assignments whose dimension isn't divisible by the axis size
+    # (e.g. a 50257 vocab over tp=4): the sharded-init value would be
+    # ill-defined.  Frameworks that want sharded embeddings pad the vocab;
+    # replicating the odd dimension is the safe materialization default.
+    shape = tuple(fake.shape)
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    fixed = []
+    for dim, axes in enumerate(entries):
+        if axes is None:
+            fixed.append(None)
+            continue
+        axis_tuple = axes if isinstance(axes, tuple) else (axes,)
+        size = 1
+        for a in axis_tuple:
+            size *= mesh.shape[a]
+        fixed.append(axes if shape[dim] % size == 0 else None)
+    return PartitionSpec(*fixed)
+
+
+def materialize_tensor_jax(
+    tensor: torch.Tensor,
+    *,
+    mesh=None,
+    spec=None,
+    seed: int = 0,
+    dtype: Optional[torch.dtype] = None,
+):
+    """Materialize one fake tensor as a ``jax.Array`` (optionally sharded)."""
+    import jax
+
+    record = _get_record(tensor) if isinstance(tensor, FakeTensor) else None
+    if record is None:
+        raise ValueError("`tensor` is not a deferred fake tensor.")
+
+    target_dtype = jnp_dtype_of(dtype or tensor.dtype)
+
+    def compute():
+        eng = _FunctionalReplay(jax.random.PRNGKey(seed), check_guards=False)
+        eng.run_call_stack(record.node)
+        return eng.value_of_output(record.node, record.index).astype(
+            target_dtype
+        )
+
+    _check_guards_of(record.node)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, spec or PartitionSpec())
+        return jax.jit(compute, out_shardings=sharding)()
+    return jax.jit(compute)()
+
+
+def _check_guards_of(target: OpNode) -> None:
+    # Guard checks touch torch tensors; run them eagerly (outside jit trace).
+    for node in _tape.build_call_stack(target):
+        for guard in node.op.guards:
+            guard.check()
+
+
+def materialize_module_jax(
+    module: nn.Module,
+    *,
+    mesh=None,
+    plan: Optional[Any] = None,
+    seed: int = 0,
+    dtype: Optional[torch.dtype] = None,
+    _fallback_torch: bool = True,
+) -> Dict[str, Any]:
+    """Materialize every fake param/buffer of ``module`` as JAX arrays.
+
+    One ``jit``-compiled program computes the full parameter pytree with
+    per-leaf ``out_shardings`` from ``plan`` — XLA SPMD generates each shard
+    on its own device.  Returns ``{qualified_name: jax.Array}``.
+
+    ``plan``: ``None`` (replicated), a dict ``{name: PartitionSpec}``, or a
+    callable ``(name, shape) -> PartitionSpec | None`` (see
+    :mod:`torchdistx_tpu.parallel.sharding` for FSDP/TP plan builders).
+    ``dtype``: optional cast applied to every leaf (e.g. ``torch.bfloat16``
+    for TPU training).
+    """
+    import jax
+
+    named = _named_fakes(module)
+    if not named:
+        return {}
+
+    # Eager guard validation (torch-side, can't run under trace).
+    for _, fake in named:
+        _check_guards_of(_get_record(fake).node)
+
+    jax_names: List[str] = []
+    unsupported: List[Tuple[str, FakeTensor]] = []
+    # Probe lowerability cheaply: every non-view node in each call stack
+    # must have a lowering.
+    for name, fake in named:
+        node = _get_record(fake).node
+        ok = True
+        for n in _tape.build_call_stack(node):
+            if _is_view_node(n):
+                continue
+            if _packet_name(n.op.func) not in LOWERINGS:
+                ok = False
+                break
+        (jax_names.append(name) if ok else unsupported.append((name, fake)))
+
+    fakes = dict(named)
+    target_dtypes = {
+        name: jnp_dtype_of(dtype or fakes[name].dtype) for name, _ in named
+    }
+
+    def compute():
+        eng = _FunctionalReplay(jax.random.PRNGKey(seed), check_guards=False)
+        # Union of all targets' call stacks, replayed once in global
+        # chronological order: a per-target replay could advance a shared
+        # storage past an earlier target's read point (write-after-read
+        # through an alias), making results depend on traversal order.
+        nodes: Dict[int, OpNode] = {}
+        for name in jax_names:
+            for n in _tape.build_call_stack(_get_record(fakes[name]).node):
+                nodes[n.op_nr] = n
+        for nr in sorted(nodes):
+            eng.run_node(nodes[nr])
+        out = {}
+        for name in jax_names:
+            rec = _get_record(fakes[name])
+            out[name] = eng.value_of_output(rec.node, rec.index).astype(
+                target_dtypes[name]
+            )
+        return out
+
+    results: Dict[str, Any] = {}
+    if jax_names:
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            shardings = {
+                name: NamedSharding(
+                    mesh, _resolve_spec(plan, name, fakes[name], mesh)
+                )
+                for name in jax_names
+            }
+            results.update(jax.jit(compute, out_shardings=shardings)())
+        else:
+            results.update(jax.jit(compute)())
+
+    # Torch fallback for ops with no lowering: replay on host, transfer with
+    # the planned sharding.  Per-tensor, so peak host RAM ≈ largest param.
+    if unsupported:
+        if not _fallback_torch:
+            raise UnsupportedOpError(
+                f"No JAX lowering for params: {[n for n, _ in unsupported]}"
+            )
+        from .deferred_init import materialize_tensor
+
+        for name, fake in unsupported:
+            real = materialize_tensor(fake, device="cpu")
+            arr = jax.numpy.asarray(
+                real.detach().cpu().numpy(), dtype=target_dtypes[name]
+            )
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+
+                arr = jax.device_put(
+                    arr,
+                    NamedSharding(mesh, _resolve_spec(plan, name, fake, mesh)),
+                )
+            results[name] = arr
+    return results

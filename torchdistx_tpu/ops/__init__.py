@@ -1,0 +1,1 @@
+from .aten_jax import LOWERINGS, UnsupportedOpError, lowering  # noqa: F401

@@ -1,0 +1,342 @@
+"""aten → JAX lowering registry for tape replay.
+
+The torch-backend materializer replays recorded aten ops natively; the
+TPU-native materializer (:mod:`torchdistx_tpu.materialize`) instead lowers
+each recorded *compute* op to JAX so the whole init subgraph runs inside one
+``jit`` with sharded outputs on a mesh.  View/aliasing ops never reach this
+registry — the functional-replay engine resolves them through strided
+gather/scatter on flat storage buffers (see materialize.py), which is the
+functional translation of the reference's mutable-storage replay
+(/root/reference/src/cc/torchdistx/deferred_init.cc:505-666).
+
+RNG lowering note: torch's in-place RNG ops (``uniform_``, ``normal_``) draw
+from the global Philox stream; here each op draws from
+``fold_in(base_key, op_nr)`` — deterministic, materialization-order
+independent, and shard-consistent under SPMD (every shard of a param sees the
+same key and XLA partitions the generation).  Statistical, not bitwise,
+parity with torch eager init — by design.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import torch
+
+from ..utils.dtypes import jnp_dtype_of
+
+LOWERINGS: Dict[str, Callable] = {}
+
+
+class UnsupportedOpError(RuntimeError):
+    """Raised when a recorded op has no JAX lowering (caller falls back to
+    torch replay + device_put)."""
+
+
+def lowering(*names: str):
+    def deco(fn):
+        for name in names:
+            LOWERINGS[name] = fn
+        return fn
+
+    return deco
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _dtype_or(kwargs, default):
+    dt = kwargs.get("dtype")
+    if dt is None:
+        return default
+    return jnp_dtype_of(dt) if isinstance(dt, torch.dtype) else dt
+
+
+# ---------------------------------------------------------------------------
+# Factories.  `ctx` provides: ctx.key (per-op PRNG key), ctx.out_meta(i)
+# (the recorded meta tensor of output i: shape/dtype ground truth).
+
+
+@lowering("aten.empty.memory_format", "aten.empty_strided.default",
+          "aten.zeros.default", "aten.empty.default")
+def _zeros(ctx, size, *args, **kwargs):
+    jnp = _jnp()
+    dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
+    return jnp.zeros(tuple(size), dtype=dtype)
+
+
+@lowering("aten.empty_like.default", "aten.zeros_like.default",
+          "aten.new_empty.default", "aten.new_zeros.default")
+def _zeros_like(ctx, x, *args, **kwargs):
+    jnp = _jnp()
+    meta = ctx.out_meta(0)
+    return jnp.zeros(tuple(meta.shape), dtype=jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.ones.default")
+def _ones(ctx, size, **kwargs):
+    jnp = _jnp()
+    dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
+    return jnp.ones(tuple(size), dtype=dtype)
+
+
+@lowering("aten.ones_like.default", "aten.new_ones.default")
+def _ones_like(ctx, x, *args, **kwargs):
+    jnp = _jnp()
+    meta = ctx.out_meta(0)
+    return jnp.ones(tuple(meta.shape), dtype=jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.full.default")
+def _full(ctx, size, fill_value, **kwargs):
+    jnp = _jnp()
+    dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
+    return jnp.full(tuple(size), fill_value, dtype=dtype)
+
+
+@lowering("aten.full_like.default", "aten.new_full.default")
+def _full_like(ctx, x, fill_value, **kwargs):
+    jnp = _jnp()
+    meta = ctx.out_meta(0)
+    return jnp.full(tuple(meta.shape), fill_value, dtype=jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.arange.default", "aten.arange.start", "aten.arange.start_step")
+def _arange(ctx, *args, **kwargs):
+    jnp = _jnp()
+    meta = ctx.out_meta(0)
+    start, end, step = 0, None, 1
+    if len(args) == 1:
+        (end,) = args
+    elif len(args) == 2:
+        start, end = args
+    else:
+        start, end, step = args[:3]
+    return jnp.arange(start, end, step, dtype=jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.eye.default", "aten.eye.m")
+def _eye(ctx, n, m=None, **kwargs):
+    jnp = _jnp()
+    meta = ctx.out_meta(0)
+    return jnp.eye(n, m, dtype=jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.scalar_tensor.default")
+def _scalar_tensor(ctx, value, **kwargs):
+    jnp = _jnp()
+    dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
+    return jnp.asarray(value, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# RNG ops (in-place on torch, pure here).
+
+
+@lowering("aten.uniform_.default")
+def _uniform_(ctx, x, from_=0.0, to=1.0, **kwargs):
+    import jax
+
+    return jax.random.uniform(
+        ctx.key, x.shape, dtype=x.dtype, minval=from_, maxval=to
+    )
+
+
+@lowering("aten.normal_.default")
+def _normal_(ctx, x, mean=0.0, std=1.0, **kwargs):
+    import jax
+
+    return jax.random.normal(ctx.key, x.shape, dtype=x.dtype) * std + mean
+
+
+@lowering("aten.randn.default")
+def _randn(ctx, size, **kwargs):
+    import jax
+
+    dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
+    return jax.random.normal(ctx.key, tuple(size), dtype=dtype)
+
+
+@lowering("aten.rand.default")
+def _rand(ctx, size, **kwargs):
+    import jax
+
+    dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
+    return jax.random.uniform(ctx.key, tuple(size), dtype=dtype)
+
+
+@lowering("aten.randint.default", "aten.randint.low")
+def _randint(ctx, *args, **kwargs):
+    import jax
+
+    meta = ctx.out_meta(0)
+    if len(args) == 2:
+        low, (high, size) = 0, args
+    else:
+        low, high, size = args[:3]
+    return jax.random.randint(
+        ctx.key, tuple(size), low, high, dtype=jnp_dtype_of(meta.dtype)
+    )
+
+
+@lowering("aten.randperm.default")
+def _randperm(ctx, n, **kwargs):
+    import jax
+
+    meta = ctx.out_meta(0)
+    return jax.random.permutation(ctx.key, n).astype(jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.bernoulli_.float")
+def _bernoulli_(ctx, x, p=0.5, **kwargs):
+    import jax
+
+    return jax.random.bernoulli(ctx.key, p, x.shape).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / in-place arithmetic (in-place variants are pure here; the
+# engine scatters results back through the written tensor's layout).
+
+
+def _binop(fn):
+    def lowered(ctx, a, b, *, alpha=None, **kwargs):
+        jnp = _jnp()
+        if alpha is not None and alpha != 1:
+            b = b * alpha
+        out = fn(a, b)
+        meta = ctx.out_meta(0)
+        return out.astype(jnp_dtype_of(meta.dtype))
+
+    return lowered
+
+
+for _names, _fn in [
+    (("aten.add.Tensor", "aten.add_.Tensor", "aten.add.Scalar",
+      "aten.add_.Scalar"), lambda a, b: a + b),
+    (("aten.sub.Tensor", "aten.sub_.Tensor", "aten.sub.Scalar",
+      "aten.sub_.Scalar"), lambda a, b: a - b),
+    (("aten.mul.Tensor", "aten.mul_.Tensor", "aten.mul.Scalar",
+      "aten.mul_.Scalar"), lambda a, b: a * b),
+    (("aten.div.Tensor", "aten.div_.Tensor", "aten.div.Scalar",
+      "aten.div_.Scalar"), lambda a, b: a / b),
+    (("aten.pow.Tensor_Scalar", "aten.pow_.Scalar"), lambda a, b: a**b),
+]:
+    LOWERINGS.update({n: _binop(_fn) for n in _names})
+
+
+def _unop(fn):
+    def lowered(ctx, x, *args, **kwargs):
+        return fn(_jnp(), x, *args, **kwargs)
+
+    return lowered
+
+
+LOWERINGS.update(
+    {
+        "aten.zero_.default": _unop(lambda jnp, x: jnp.zeros_like(x)),
+        "aten.fill_.Scalar": _unop(lambda jnp, x, v: jnp.full_like(x, v)),
+        "aten.fill_.Tensor": _unop(lambda jnp, x, v: jnp.full_like(x, v)),
+        "aten.neg.default": _unop(lambda jnp, x: -x),
+        "aten.neg_.default": _unop(lambda jnp, x: -x),
+        "aten.sqrt.default": _unop(lambda jnp, x: jnp.sqrt(x)),
+        "aten.sqrt_.default": _unop(lambda jnp, x: jnp.sqrt(x)),
+        "aten.rsqrt.default": _unop(lambda jnp, x: 1 / jnp.sqrt(x)),
+        "aten.abs.default": _unop(lambda jnp, x: jnp.abs(x)),
+        "aten.exp.default": _unop(lambda jnp, x: jnp.exp(x)),
+        "aten.exp_.default": _unop(lambda jnp, x: jnp.exp(x)),
+        "aten.log.default": _unop(lambda jnp, x: jnp.log(x)),
+        "aten.tanh.default": _unop(lambda jnp, x: jnp.tanh(x)),
+        "aten.sigmoid.default": _unop(lambda jnp, x: 1 / (1 + jnp.exp(-x))),
+        "aten.tril.default": _unop(lambda jnp, x, k=0: jnp.tril(x, k)),
+        "aten.tril_.default": _unop(lambda jnp, x, k=0: jnp.tril(x, k)),
+        "aten.triu.default": _unop(lambda jnp, x, k=0: jnp.triu(x, k)),
+        "aten.triu_.default": _unop(lambda jnp, x, k=0: jnp.triu(x, k)),
+        "aten.reciprocal.default": _unop(lambda jnp, x: 1 / x),
+    }
+)
+
+
+@lowering("aten.erfinv.default", "aten.erfinv_.default")
+def _erfinv(ctx, x, **kwargs):
+    from jax.scipy.special import erfinv
+
+    return erfinv(x)
+
+
+@lowering("aten.clamp.default", "aten.clamp_.default")
+def _clamp(ctx, x, min=None, max=None, **kwargs):
+    jnp = _jnp()
+    return jnp.clip(x, min, max)
+
+
+@lowering("aten.clamp_min.default", "aten.clamp_min_.default")
+def _clamp_min(ctx, x, min, **kwargs):
+    return _jnp().clip(x, min, None)
+
+
+@lowering("aten.clamp_max.default", "aten.clamp_max_.default")
+def _clamp_max(ctx, x, max, **kwargs):
+    return _jnp().clip(x, None, max)
+
+
+@lowering("aten.copy_.default")
+def _copy_(ctx, dst, src, non_blocking=False, **kwargs):
+    jnp = _jnp()
+    return jnp.broadcast_to(src, dst.shape).astype(dst.dtype)
+
+
+@lowering("aten._to_copy.default", "aten.to.dtype", "aten.clone.default")
+def _to_copy(ctx, x, **kwargs):
+    meta = ctx.out_meta(0)
+    return x.astype(jnp_dtype_of(meta.dtype))
+
+
+@lowering("aten.cat.default")
+def _cat(ctx, tensors, dim=0, **kwargs):
+    return _jnp().concatenate(tensors, axis=dim)
+
+
+@lowering("aten.stack.default")
+def _stack(ctx, tensors, dim=0, **kwargs):
+    return _jnp().stack(tensors, axis=dim)
+
+
+@lowering("aten.mm.default", "aten.matmul.default", "aten.bmm.default")
+def _mm(ctx, a, b, **kwargs):
+    return a @ b
+
+
+@lowering("aten.addmm.default")
+def _addmm(ctx, bias, a, b, *, beta=1, alpha=1, **kwargs):
+    return beta * bias + alpha * (a @ b)
+
+
+@lowering("aten.outer.default")
+def _outer(ctx, a, b, **kwargs):
+    return _jnp().outer(a, b)
+
+
+@lowering("aten.linalg_qr.default")
+def _qr(ctx, x, mode="reduced", **kwargs):
+    jnp = _jnp()
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return [q, r]
+
+
+@lowering("aten.sign.default")
+def _sign(ctx, x, **kwargs):
+    return _jnp().sign(x)
+
+
+@lowering("aten.diag.default", "aten.diagonal.default")
+def _diag(ctx, x, *args, **kwargs):
+    return _jnp().diagonal(x, *args) if x.ndim > 1 else _jnp().diag(x)
+
+
+@lowering("aten.repeat.default")
+def _repeat(ctx, x, repeats, **kwargs):
+    return _jnp().tile(x, tuple(repeats))

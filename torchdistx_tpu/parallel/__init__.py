@@ -1,0 +1,9 @@
+from .mesh import make_mesh, MeshSpec  # noqa: F401
+from .sharding import (  # noqa: F401
+    fsdp_plan,
+    fsdp_over,
+    tp_plan_gpt2,
+    tp_plan_llama,
+    combine_plans,
+    replicated_plan,
+)

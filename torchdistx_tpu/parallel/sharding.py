@@ -1,0 +1,144 @@
+"""Sharding-plan builders: parameter name/shape → ``PartitionSpec``.
+
+The shard-then-materialize flow (docs/src/deferred_init.rst:17-44 is the
+reference's motivation; it never implements the sharding itself) needs a
+*plan*: a mapping from parameter names/shapes to mesh partition specs.  A
+plan is any ``(name, shape) -> PartitionSpec | None`` callable; builders here
+compose FSDP-style and Megatron-TP-style rules.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, Optional, Sequence, Tuple
+
+Plan = Callable[[str, Tuple[int, ...]], object]
+
+
+def _pspec(*axes):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*axes)
+
+
+def replicated_plan() -> Plan:
+    return lambda name, shape: _pspec()
+
+
+def fsdp_plan(
+    axis: str = "fsdp",
+    *,
+    min_size: int = 1024,
+    largest_dim: bool = True,
+) -> Plan:
+    """ZeRO-3-style parameter sharding: shard every big-enough param along
+    one dimension of the ``axis`` mesh axis.
+
+    ``largest_dim=True`` shards the largest dimension (best balance and the
+    dimension most likely divisible by the axis size); otherwise dim 0.
+    Params smaller than ``min_size`` elements stay replicated (the classic
+    FSDP small-tensor exemption).
+    """
+
+    def plan(name: str, shape: Tuple[int, ...]):
+        n = 1
+        for s in shape:
+            n *= s
+        if not shape or n < min_size:
+            return _pspec()
+        dim = max(range(len(shape)), key=lambda i: shape[i]) if largest_dim else 0
+        spec = [None] * len(shape)
+        spec[dim] = axis
+        return _pspec(*spec)
+
+    return plan
+
+
+def _regex_plan(rules: Iterable[Tuple[str, Sequence[Optional[str]]]]) -> Plan:
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def plan(name: str, shape: Tuple[int, ...]):
+        for pat, spec in compiled:
+            if pat.search(name):
+                # A spec shorter than the rank is implicitly None-padded by
+                # PartitionSpec semantics.
+                return _pspec(*list(spec)[: len(shape)])
+        return None
+
+    return plan
+
+
+def tp_plan_gpt2(axis: str = "tp") -> Plan:
+    """Megatron-style TP rules for GPT-2-family (HF naming, Conv1D weights
+    are (in, out)): column-parallel QKV/MLP-up on the out dim, row-parallel
+    proj/MLP-down on the in dim, embeddings on vocab/model dim."""
+    return _regex_plan(
+        [
+            (r"c_attn\.weight$", (None, axis)),
+            (r"c_attn\.bias$", (axis,)),
+            (r"c_fc\.weight$", (None, axis)),
+            (r"c_fc\.bias$", (axis,)),
+            (r"c_proj\.weight$", (axis, None)),
+            (r"c_proj\.bias$", ()),
+            (r"(wte|lm_head)\.weight$", (axis, None)),
+            (r"wpe\.weight$", ()),
+            (r"ln_\w*\.(weight|bias)$", ()),
+        ]
+    )
+
+
+def tp_plan_llama(axis: str = "tp") -> Plan:
+    """Megatron-style TP rules for Llama-family (HF naming, Linear weights
+    are (out, in)): column-parallel q/k/v/gate/up on dim 0, row-parallel
+    o/down on dim 1, vocab-parallel embeddings."""
+    return _regex_plan(
+        [
+            (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$", (axis, None)),
+            (r"(o_proj|down_proj)\.weight$", (None, axis)),
+            (r"(embed_tokens|lm_head)\.weight$", (axis, None)),
+            (r"norm\.weight$", ()),
+        ]
+    )
+
+
+def fsdp_over(base: Plan, axis: str = "fsdp", *, min_size: int = 1024) -> Plan:
+    """2-D sharding: apply ``base`` (e.g. a TP plan), then additionally shard
+    the largest still-unsharded dimension along ``axis`` — the FSDP+TP
+    layout of BASELINE config 5 (Llama-70B on v5p-128)."""
+
+    def plan(name: str, shape: Tuple[int, ...]):
+        spec = base(name, shape)
+        entries = list(spec) if spec is not None else []
+        entries += [None] * (len(shape) - len(entries))
+        n = 1
+        for s in shape:
+            n *= s
+        if n >= min_size:
+            free = [i for i, e in enumerate(entries) if e is None]
+            if free:
+                dim = max(free, key=lambda i: shape[i])
+                entries[dim] = axis
+        return _pspec(*entries)
+
+    return plan
+
+
+def combine_plans(*plans: Plan) -> Plan:
+    """First plan returning a non-None spec wins; else replicated.
+
+    Compose TP rules over an FSDP default:
+    ``combine_plans(tp_plan_llama(), fsdp_plan())`` = 2-D "FSDP + TP".
+    """
+
+    def plan(name: str, shape: Tuple[int, ...]):
+        for p in plans:
+            spec = p(name, shape)
+            if spec is not None and tuple(spec) != ():
+                return spec
+        for p in plans:
+            spec = p(name, shape)
+            if spec is not None:
+                return spec
+        return _pspec()
+
+    return plan

@@ -1,0 +1,1 @@
+from .dtypes import jnp_dtype_of, torch_dtype_of  # noqa: F401

@@ -1,0 +1,48 @@
+"""torch ↔ jax dtype mapping."""
+
+from __future__ import annotations
+
+import functools
+
+import torch
+
+
+@functools.cache
+def _tables():
+    import jax.numpy as jnp
+    import numpy as np
+
+    t2j = {
+        torch.float32: jnp.float32,
+        torch.float64: jnp.float64,
+        torch.float16: jnp.float16,
+        torch.bfloat16: jnp.bfloat16,
+        torch.int8: jnp.int8,
+        torch.int16: jnp.int16,
+        torch.int32: jnp.int32,
+        torch.int64: jnp.int64,
+        torch.uint8: jnp.uint8,
+        torch.bool: jnp.bool_,
+        torch.complex64: jnp.complex64,
+        torch.complex128: jnp.complex128,
+    }
+    j2t = {np.dtype(j): t for t, j in t2j.items()}
+    return t2j, j2t
+
+
+def jnp_dtype_of(torch_dtype: torch.dtype):
+    t2j, _ = _tables()
+    try:
+        return t2j[torch_dtype]
+    except KeyError:
+        raise TypeError(f"No JAX dtype for {torch_dtype}") from None
+
+
+def torch_dtype_of(jnp_dtype) -> torch.dtype:
+    import numpy as np
+
+    _, j2t = _tables()
+    try:
+        return j2t[np.dtype(jnp_dtype)]
+    except KeyError:
+        raise TypeError(f"No torch dtype for {jnp_dtype}") from None
