@@ -1,0 +1,98 @@
+// See graph.h. Semantics mirror torchdistx_tpu/_tape.py exactly (the Python
+// implementation is the executable spec; tests/test_native_tape.py asserts
+// both paths produce identical schedules).
+
+#include "graph.h"
+
+#include <algorithm>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Node {
+  int64_t op_nr;
+  std::vector<int64_t> deps;        // producer op_nrs (argument edges)
+  std::vector<int64_t> dependents;  // later writers of aliased storages
+};
+
+}  // namespace
+
+struct tdx_graph {
+  std::unordered_map<int64_t, Node> nodes;
+  // storage key -> op_nrs that wrote it, in record order.
+  std::unordered_map<uint64_t, std::vector<int64_t>> writers;
+};
+
+extern "C" {
+
+tdx_graph* tdx_graph_new() { return new tdx_graph(); }
+
+void tdx_graph_free(tdx_graph* g) { delete g; }
+
+int tdx_graph_add_node(tdx_graph* g, int64_t op_nr) {
+  auto [it, inserted] = g->nodes.try_emplace(op_nr);
+  if (!inserted) return -1;
+  it->second.op_nr = op_nr;
+  return 0;
+}
+
+int tdx_graph_add_dep(tdx_graph* g, int64_t op_nr, int64_t producer_op_nr) {
+  auto it = g->nodes.find(op_nr);
+  if (it == g->nodes.end() || g->nodes.find(producer_op_nr) == g->nodes.end())
+    return -1;
+  it->second.deps.push_back(producer_op_nr);
+  return 0;
+}
+
+int tdx_graph_note_write(tdx_graph* g, int64_t op_nr, uint64_t storage_key) {
+  auto it = g->nodes.find(op_nr);
+  if (it == g->nodes.end()) return -1;
+  auto& entries = g->writers[storage_key];
+  for (int64_t prev_nr : entries) {
+    if (prev_nr == op_nr) continue;
+    auto prev = g->nodes.find(prev_nr);
+    if (prev != g->nodes.end()) prev->second.dependents.push_back(op_nr);
+  }
+  entries.push_back(op_nr);
+  return 0;
+}
+
+int64_t tdx_graph_num_nodes(const tdx_graph* g) {
+  return static_cast<int64_t>(g->nodes.size());
+}
+
+int64_t tdx_graph_call_stack(const tdx_graph* g, int64_t target_op_nr,
+                             int64_t* out, int64_t cap) {
+  auto target = g->nodes.find(target_op_nr);
+  if (target == g->nodes.end()) return -1;
+
+  // Horizon: the last in-place op touching the target's storages
+  // (getLastInPlaceOpNode, deferred_init.cc:540-578).
+  int64_t horizon = target_op_nr;
+  for (int64_t d : target->second.dependents) horizon = std::max(horizon, d);
+
+  // Transitive closure over deps + in-horizon dependents
+  // (collectCallStack, deferred_init.cc:580-621).
+  std::unordered_set<int64_t> seen;
+  std::vector<int64_t> work{target_op_nr};
+  std::vector<int64_t> result;
+  while (!work.empty()) {
+    int64_t nr = work.back();
+    work.pop_back();
+    if (!seen.insert(nr).second) continue;
+    result.push_back(nr);
+    const Node& node = g->nodes.at(nr);
+    for (int64_t d : node.deps) work.push_back(d);
+    for (int64_t d : node.dependents)
+      if (d <= horizon) work.push_back(d);
+  }
+  std::sort(result.begin(), result.end());
+
+  int64_t n = static_cast<int64_t>(result.size());
+  for (int64_t i = 0; i < std::min(n, cap); ++i) out[i] = result[i];
+  return n;
+}
+
+}  // extern "C"

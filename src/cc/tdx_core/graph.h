@@ -1,0 +1,60 @@
+// tdx_core: native op-tape graph for torchdistx_tpu.
+//
+// TPU-native counterpart of the reference's C++ graph machinery
+// (/root/reference/src/cc/torchdistx/deferred_init.cc:311-710): chronological
+// OpNode graph with dependency edges, a storage->writers alias index
+// installing dependent back-edges, and the materialization call-stack builder
+// (last-in-place-op horizon search + transitive-closure collection +
+// chronological sort, deferred_init.cc:529-621).
+//
+// The Python layer (torchdistx_tpu/_tape.py) owns op payloads (callables,
+// preserved argument stacks); this library owns the *structure* and the
+// traversals that dominate materialization scheduling cost on large tapes.
+// Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
+
+#pragma once
+
+#include <cstdint>
+
+#if defined(__GNUC__)
+#define TDX_API __attribute__((visibility("default")))
+#else
+#define TDX_API
+#endif
+
+extern "C" {
+
+typedef struct tdx_graph tdx_graph;
+
+// Lifecycle -----------------------------------------------------------------
+TDX_API tdx_graph* tdx_graph_new();
+TDX_API void tdx_graph_free(tdx_graph* g);
+
+// Construction (record time) ------------------------------------------------
+// Register a node keyed by its chronological op_nr. Returns 0 on success,
+// -1 if the op_nr already exists.
+TDX_API int tdx_graph_add_node(tdx_graph* g, int64_t op_nr);
+
+// Add a dependency edge: `op_nr` consumes an output of `producer_op_nr`.
+// Returns 0, or -1 if either node is unknown.
+TDX_API int tdx_graph_add_dep(tdx_graph* g, int64_t op_nr,
+                              int64_t producer_op_nr);
+
+// Note that `op_nr` wrote storage `storage_key`. Installs dependent
+// back-edges from every earlier writer of the same storage (the reference's
+// dependents_ wiring, deferred_init.cc:397,463-495). Returns 0 or -1.
+TDX_API int tdx_graph_note_write(tdx_graph* g, int64_t op_nr,
+                                 uint64_t storage_key);
+
+// Queries -------------------------------------------------------------------
+TDX_API int64_t tdx_graph_num_nodes(const tdx_graph* g);
+
+// Materialization call-stack for `target_op_nr` (deferred_init.cc:529-621):
+// horizon = latest dependent writer of the target's storages; closure over
+// dependency edges plus dependents within the horizon; chronological order.
+// Writes up to `cap` op_nrs into `out`; returns the total count (call with
+// cap=0 to size the buffer), or -1 if the target is unknown.
+TDX_API int64_t tdx_graph_call_stack(const tdx_graph* g, int64_t target_op_nr,
+                                     int64_t* out, int64_t cap);
+
+}  // extern "C"

@@ -7,6 +7,10 @@ The environment's sitecustomize pins ``JAX_PLATFORMS=axon`` (the tunneled
 real TPU); tests must run on virtual CPU devices, so the platform is forced
 back to cpu via ``jax.config`` (env vars alone are overwritten by the
 sitecustomize hook).
+
+JAX itself is optional: the torch-only surface (fake tensors, deferred init,
+torch materialization) must stay testable in a JAX-less environment, so the
+import is guarded and JAX-dependent test modules skip via their own imports.
 """
 
 import os
@@ -16,6 +20,23 @@ os.environ["XLA_FLAGS"] = (
     + os.environ.get("XLA_FLAGS", "")
 )
 
-import jax  # noqa: E402
+try:
+    import jax
+except ImportError:
+    jax = None
 
-jax.config.update("jax_platforms", "cpu")
+if jax is not None:
+    jax.config.update("jax_platforms", "cpu")
+else:
+    # torch-only environment: skip collection of JAX-dependent modules so
+    # the torch-surface tests (fake, deferred init, native tape) still run.
+    collect_ignore = [
+        "test_attention.py",
+        "test_checkpoint.py",
+        "test_gpt2.py",
+        "test_materialize_jax.py",
+        "test_models.py",
+        "test_sharding_plans.py",
+        "test_slowmo.py",
+        "test_train_step.py",
+    ]

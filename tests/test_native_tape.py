@@ -1,0 +1,156 @@
+"""Native (C++) tape core vs the pure-Python graph: identical schedules.
+
+The Python implementation is the executable spec; the native core
+(src/cc/tdx_core) must produce the same materialization call stacks.
+"""
+
+import subprocess
+import sys
+
+import pytest
+import torch
+
+from torchdistx_tpu import _native, _tape
+from torchdistx_tpu.deferred_init import (
+    deferred_init,
+    materialize_module,
+    materialize_tensor,
+    _get_record,
+)
+
+
+def test_native_builds_and_loads():
+    assert _native.native_available(), (
+        "native core should build on demand (g++ is in this image)"
+    )
+
+
+def test_low_level_graph_roundtrip():
+    class Node:  # weak-referenceable registry payload
+        def __init__(self, nr):
+            self.nr = nr
+
+    g = _native.NativeGraph()
+    payloads = [Node(nr) for nr in (10, 11, 12, 13)]
+    for p in payloads:
+        g.add_node(p.nr, p)
+    g.add_dep(11, 10)
+    g.add_dep(12, 11)
+    g.note_write(10, 0xABC)
+    g.note_write(13, 0xABC)  # later in-place write on the same storage
+    assert len(g) == 4
+    # target 11: deps {10}, horizon from target's dependents only (none for
+    # 11; 10's dependent 13 is pulled in via 10 within horizon? no — horizon
+    # is computed from the *target*).
+    assert g.call_stack(11) == [10, 11]
+    # target 10: dependent 13 raises the horizon and joins the stack.
+    assert g.call_stack(10) == [10, 13]
+    with pytest.raises(KeyError):
+        g.call_stack(999)
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(8, 16)
+        self.fc2 = torch.nn.Linear(16, 4)
+        self.register_buffer("scale", torch.ones(4) * 3)
+
+    def forward(self, x):
+        return self.fc2(torch.relu(self.fc1(x))) * self.scale
+
+
+def _schedules(module):
+    out = {}
+    for name, p in list(module.named_parameters()) + list(
+        module.named_buffers()
+    ):
+        rec = _get_record(p)
+        out[name] = [n.op_nr for n in _tape.build_call_stack(rec.node)]
+    return out
+
+
+def test_schedules_match_python_fallback():
+    m_native = deferred_init(Net)
+    native_used = any(
+        _get_record(p).node.native_graph is not None
+        for p in m_native.parameters()
+    )
+    assert native_used, "native graph should be active for this tape"
+    sched_native = _schedules(m_native)
+
+    # Same model recorded with the native core disabled → same schedules
+    # relative to each tape's op_nr base.
+    code = """
+import os
+os.environ["TDX_DISABLE_NATIVE"] = "1"
+import torch
+from torchdistx_tpu import _tape
+from torchdistx_tpu.deferred_init import deferred_init, _get_record
+import json
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(8, 16)
+        self.fc2 = torch.nn.Linear(16, 4)
+        self.register_buffer("scale", torch.ones(4) * 3)
+
+m = deferred_init(Net)
+assert all(
+    _get_record(p).node.native_graph is None for p in m.parameters()
+)
+out = {}
+base = None
+for name, t in list(m.named_parameters()) + list(m.named_buffers()):
+    rec = _get_record(t)
+    nrs = [n.op_nr for n in _tape.build_call_stack(rec.node)]
+    if base is None:
+        base = min(nrs)
+    out[name] = nrs
+print(json.dumps({"base": base, "sched": out}))
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, check=True
+    )
+    import json
+
+    py = json.loads(proc.stdout.strip().splitlines()[-1])
+    base_native = min(min(v) for v in sched_native.values())
+    rel_native = {
+        k: [nr - base_native for nr in v] for k, v in sched_native.items()
+    }
+    rel_py = {
+        k: [nr - py["base"] for nr in v] for k, v in py["sched"].items()
+    }
+    assert rel_native == rel_py
+
+
+def test_materialize_through_native_path():
+    m = deferred_init(Net)
+    materialize_module(m)
+    assert torch.equal(m.scale, torch.ones(4) * 3)
+    x = torch.randn(2, 8)
+    y = m(x)
+    assert y.shape == (2, 4)
+
+
+def test_identity_preserved_through_native_path():
+    m = deferred_init(Net)
+    a = materialize_tensor(m.fc1.weight)
+    b = materialize_tensor(m.fc1.weight)
+    assert a is b
+    assert isinstance(a, torch.nn.Parameter)
+
+
+def test_inplace_horizon_through_native_path():
+    def build():
+        t = torch.ones(4)
+        u = t[:2]  # view
+        u.add_(1.0)  # in-place on the view, later than t's producer
+        return t, u
+
+    t, u = deferred_init(build)
+    real_t = materialize_tensor(t)
+    # The in-place write through the view must be visible in t.
+    assert torch.equal(real_t, torch.tensor([2.0, 2.0, 1.0, 1.0]))

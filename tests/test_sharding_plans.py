@@ -1,0 +1,47 @@
+"""Sharding-plan builders and the shared mesh-fitting rules."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from torchdistx_tpu.parallel.mesh import MeshSpec, make_mesh
+from torchdistx_tpu.parallel.sharding import (
+    combine_plans,
+    fit_spec_to_mesh,
+    fsdp_over,
+    fsdp_plan,
+    replicate_indivisible,
+    tp_plan_llama,
+)
+
+
+def test_combine_plans_honors_explicit_replication():
+    # tp_plan_llama replicates norm.weight with an explicit P(); a later
+    # FSDP catch-all must NOT override it.
+    plan = combine_plans(tp_plan_llama(), fsdp_plan(min_size=1))
+    assert tuple(plan("model.norm.weight", (4096,))) == ()
+    # Unmatched names fall through to the FSDP rule.
+    assert plan("model.other.weight", (4096, 64)) == P("fsdp", None)
+
+
+def test_fsdp_over_shards_free_dims():
+    plan = fsdp_over(tp_plan_llama(), min_size=1)
+    spec = plan("layers.0.q_proj.weight", (64, 64))
+    assert spec == P("tp", "fsdp")
+    # norm stays fully replicated (no free large dim under min_size rule
+    # still shards 1-d? shape (64,) has a free dim -> fsdp over it)
+    spec = plan("model.norm.weight", (64,))
+    assert spec == P("fsdp")
+
+
+def test_fit_spec_to_mesh_drops_absent_axes():
+    mesh = make_mesh(MeshSpec(dp=8))
+    assert fit_spec_to_mesh(P("fsdp", "tp"), mesh) == P(None, None)
+    assert fit_spec_to_mesh(P(("dp", "fsdp"), None), mesh) == P("dp", None)
+
+
+def test_replicate_indivisible():
+    mesh = make_mesh(MeshSpec(tp=3), devices=jax.devices()[:3])
+    assert replicate_indivisible(P("tp"), (9,), mesh) == P("tp")
+    assert replicate_indivisible(P("tp"), (10,), mesh) == P(None)
+    # shorter spec than rank is padded
+    assert replicate_indivisible(P("tp"), (9, 5), mesh) == P("tp", None)

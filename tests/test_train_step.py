@@ -1,0 +1,177 @@
+"""Sharded training steps on the virtual 8-device mesh (SURVEY.md §4 rig).
+
+Covers dp/fsdp/tp composition, sequence-parallel (ring) training, and the
+SlowMo stacked-replica step with its closed-form oracle — the analog of the
+reference's analytic momentum recomputation (test_slowmo_fsdp.py:243-253).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.parallel import train_step as ts
+from torchdistx_tpu.parallel.mesh import MeshSpec, make_mesh
+from torchdistx_tpu.parallel.slowmo import SlowMomentumOptimizer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.llama_test()
+
+
+def _batch(cfg, sharding, shape=(8, 32), seed=1):
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(seed), shape, 0, cfg.vocab_size),
+        sharding,
+    )
+    return {"tokens": tokens, "targets": tokens}
+
+
+class TestTrainStep:
+    def test_3d_mesh_loss_decreases(self, cfg):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.adamw(1e-2))
+        state = init_fn(jax.random.PRNGKey(0))
+        batch = _batch(cfg, ts.batch_sharding(mesh))
+        losses = []
+        for _ in range(4):
+            state, m = step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        assert int(jnp.asarray(state.step)) == 4
+
+    def test_sharding_invariance(self, cfg):
+        # Same seed, different mesh layouts → numerically close results.
+        results = []
+        for spec in (MeshSpec(dp=8), MeshSpec(fsdp=4, tp=2)):
+            mesh = make_mesh(spec)
+            init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.sgd(0.1))
+            state = init_fn(jax.random.PRNGKey(0))
+            batch = _batch(cfg, ts.batch_sharding(mesh))
+            state, m = step_fn(state, batch)
+            results.append(float(m["loss"]))
+        assert abs(results[0] - results[1]) < 1e-3
+
+    def test_sequence_parallel_matches_single(self, cfg):
+        tokens_shape = (8, 64)
+        mesh_sp = make_mesh(MeshSpec(fsdp=2, sp=4))
+        init_fn, step_fn = ts.make_train_step(
+            cfg, mesh_sp, optax.sgd(0.1), seq_axis="sp", attn_impl="ring"
+        )
+        state = init_fn(jax.random.PRNGKey(0))
+        batch = _batch(cfg, ts.batch_sharding(mesh_sp), tokens_shape)
+        state, m_sp = step_fn(state, batch)
+
+        mesh_1 = make_mesh(MeshSpec(dp=8))
+        init_fn, step_fn = ts.make_train_step(
+            cfg, mesh_1, optax.sgd(0.1), attn_impl="jnp"
+        )
+        state = init_fn(jax.random.PRNGKey(0))
+        batch = _batch(cfg, ts.batch_sharding(mesh_1), tokens_shape)
+        state, m_1 = step_fn(state, batch)
+        assert abs(float(m_sp["loss"]) - float(m_1["loss"])) < 1e-3
+
+
+class TestOptStatePlacement:
+    def test_moments_follow_param_shardings_by_path(self, cfg):
+        """wq and wo share a shape but have transposed shardings; the Adam
+        moments must follow each param's own sharding (path match, not
+        shape match)."""
+        mesh = make_mesh(MeshSpec(fsdp=2, tp=4))
+        init_fn, _ = ts.make_train_step(cfg, mesh, optax.adamw(1e-3))
+        state = init_fn(jax.random.PRNGKey(0))
+        P = jax.sharding.PartitionSpec
+        adam = state.opt_state[0]  # ScaleByAdamState
+        assert adam.mu["layers"]["wq"].sharding.spec == P(None, "fsdp", "tp")
+        assert adam.mu["layers"]["wo"].sharding.spec == P(None, "tp", "fsdp")
+        assert adam.nu["layers"]["wo"].sharding.spec == P(None, "tp", "fsdp")
+
+
+class TestSlowMoTrainStep:
+    def test_replicas_sync_on_averaging_step(self, cfg):
+        mesh = make_mesh(MeshSpec(dp=2, tp=4))
+        opt = SlowMomentumOptimizer(
+            optax.sgd(0.1), base_lr=0.1, slowmo_freq=2
+        )
+        init_fn, step_fn = ts.make_slowmo_train_step(cfg, mesh, opt)
+        state = init_fn(jax.random.PRNGKey(0))
+        bs = ts.slowmo_batch_sharding(mesh)
+        batch = _batch(cfg, bs, (2, 4, 32))
+
+        state, _ = step_fn(state, batch)  # step 1: replicas diverge
+        wq = np.asarray(state.params["layers"]["wq"])
+        # Same data per replica here? No — batch[0] != batch[1] slices, and
+        # even with equal data SGD would match; use distinct slices:
+        state, _ = step_fn(state, batch)  # step 2: averaging step
+        wq = np.asarray(state.params["layers"]["wq"])
+        assert np.array_equal(wq[0], wq[1])  # exact sync after averaging
+
+    def test_replicas_diverge_between_averaging(self, cfg):
+        mesh = make_mesh(MeshSpec(dp=2, tp=4))
+        opt = SlowMomentumOptimizer(
+            optax.sgd(0.1), base_lr=0.1, slowmo_freq=100
+        )
+        init_fn, step_fn = ts.make_slowmo_train_step(cfg, mesh, opt)
+        state = init_fn(jax.random.PRNGKey(0))
+        bs = ts.slowmo_batch_sharding(mesh)
+        # distinct per-replica data
+        t = jax.random.randint(
+            jax.random.PRNGKey(5), (2, 4, 32), 0, cfg.vocab_size
+        )
+        batch = {"tokens": jax.device_put(t, bs), "targets": jax.device_put(t, bs)}
+        state, _ = step_fn(state, batch)
+        wq = np.asarray(state.params["layers"]["wq"])
+        assert not np.array_equal(wq[0], wq[1])
+
+    def test_slowmo_math_oracle(self, cfg):
+        """Recompute the slow-momentum update analytically (the reference's
+        closed-form oracle, test_slowmo_fsdp.py:243-253)."""
+        mesh = make_mesh(MeshSpec(dp=2, tp=4))
+        base_lr, factor, slr = 0.1, 0.5, 1.0
+        opt = SlowMomentumOptimizer(
+            optax.sgd(base_lr), base_lr=base_lr, slowmo_freq=1,
+            slowmo_factor=factor, slowmo_lr=slr,
+        )
+        init_fn, step_fn = ts.make_slowmo_train_step(cfg, mesh, opt)
+        state = init_fn(jax.random.PRNGKey(0))
+        prev0 = np.asarray(state.opt_state.prev["layers"]["wq"])
+        bs = ts.slowmo_batch_sharding(mesh)
+        batch = _batch(cfg, bs, (2, 4, 32))
+        state, _ = step_fn(state, batch)
+        # freq=1 → averaging every step.  m1 = factor*0 + (prev - avg)/lr;
+        # prev1 = prev - slr*lr*m1; params = prev1 (broadcast).
+        wq = np.asarray(state.params["layers"]["wq"])
+        prev1 = np.asarray(state.opt_state.prev["layers"]["wq"])
+        m1 = np.asarray(state.opt_state.momentum["layers"]["wq"])
+        # params equal prev after averaging step
+        assert np.allclose(wq[0], prev1, atol=1e-6)
+        assert np.allclose(wq[1], prev1, atol=1e-6)
+        # prev update identity
+        assert np.allclose(prev1, prev0 - slr * base_lr * m1, atol=1e-6)
+
+    def test_state_checkpoint_roundtrip(self, cfg, tmp_path):
+        """SlowMo state round-trips through orbax (the reference round-trips
+        through torch.save, test_slowmo_fsdp.py:283-300)."""
+        import orbax.checkpoint as ocp
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=4))
+        opt = SlowMomentumOptimizer(optax.sgd(0.1), base_lr=0.1, slowmo_freq=2)
+        init_fn, step_fn = ts.make_slowmo_train_step(cfg, mesh, opt)
+        state = init_fn(jax.random.PRNGKey(0))
+        batch = _batch(cfg, ts.slowmo_batch_sharding(mesh), (2, 4, 32))
+        state, _ = step_fn(state, batch)
+
+        path = tmp_path / "ckpt"
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, jax.tree.map(np.asarray, state))
+        ckptr.wait_until_finished()
+        target = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), state
+        )
+        restored = ckptr.restore(path, target)
+        assert jax.tree.structure(restored) == jax.tree.structure(state)
+        for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+            assert np.allclose(np.asarray(a), np.asarray(b))

@@ -1,0 +1,171 @@
+"""ctypes bindings for the native core (libtdx_core.so).
+
+The reference binds its C++ runtime through a pybind11 extension
+(/root/reference/src/python/torchdistx/_C/); pybind11 isn't available in this
+environment, so the native core exposes a C ABI (src/cc/tdx_core/graph.h)
+bound here with ctypes — same layering, different binding tech.
+
+Loading is lazy and failure-tolerant: if the library isn't built (or g++ is
+unavailable for the on-demand build), the tape falls back to the pure-Python
+graph with identical semantics.  ``TDX_DISABLE_NATIVE=1`` forces the
+fallback (used by tests to compare both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+_LIB_PATH = os.path.join(_PKG_DIR, "lib", "libtdx_core.so")
+_SRC = os.path.join(_REPO_ROOT, "src", "cc", "tdx_core", "graph.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _stale() -> bool:
+    try:
+        return os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+
+
+def _try_build() -> bool:
+    """One-shot on-demand build (g++, single TU) so the native path is live
+    in dev checkouts without a separate build step.
+
+    Compiles to a process-unique temp file and ``os.replace``s it into
+    place: concurrent processes (parallel pytest, pytest + bench) must never
+    dlopen a half-written .so or truncate one another process has mapped.
+    """
+    if not os.path.exists(_SRC):
+        return False
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+        subprocess.run(
+            [
+                "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+                "-o", tmp, _SRC,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("TDX_DISABLE_NATIVE"):
+            _load_failed = True
+            return None
+        if (not os.path.exists(_LIB_PATH) or _stale()) and not _try_build():
+            _load_failed = True
+            if not os.path.exists(_LIB_PATH):
+                return None
+            # Stale but rebuild failed: fall through and use the existing
+            # library rather than silently losing the native path entirely.
+            _load_failed = False
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.tdx_graph_new.restype = ctypes.c_void_p
+        lib.tdx_graph_free.argtypes = [ctypes.c_void_p]
+        lib.tdx_graph_add_node.restype = ctypes.c_int
+        lib.tdx_graph_add_node.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.tdx_graph_add_dep.restype = ctypes.c_int
+        lib.tdx_graph_add_dep.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.tdx_graph_note_write.restype = ctypes.c_int
+        lib.tdx_graph_note_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+        ]
+        lib.tdx_graph_num_nodes.restype = ctypes.c_int64
+        lib.tdx_graph_num_nodes.argtypes = [ctypes.c_void_p]
+        lib.tdx_graph_call_stack.restype = ctypes.c_int64
+        lib.tdx_graph_call_stack.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeGraph:
+    """Owning handle over a tdx_graph, plus the op_nr → OpNode registry the
+    Python side needs to map native schedules back to payloads.
+
+    The registry holds nodes *weakly*: every node a call-stack traversal can
+    return is also strongly reachable from the target through the Python
+    graph edges (OutputRef deps / dependents lists), and a strong registry
+    would pin the entire tape for as long as any single node survives —
+    defeating the incremental freeing the weakref-based Python writers index
+    provides.
+    """
+
+    def __init__(self):
+        import weakref
+
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core unavailable")
+        self._lib = lib
+        self._ptr = lib.tdx_graph_new()
+        self.nodes = weakref.WeakValueDictionary()  # op_nr -> OpNode
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.tdx_graph_free(ptr)
+            self._ptr = None
+
+    def add_node(self, op_nr: int, node) -> None:
+        self._lib.tdx_graph_add_node(self._ptr, op_nr)
+        self.nodes[op_nr] = node
+
+    def add_dep(self, op_nr: int, producer_op_nr: int) -> None:
+        self._lib.tdx_graph_add_dep(self._ptr, op_nr, producer_op_nr)
+
+    def note_write(self, op_nr: int, storage_key: int) -> None:
+        self._lib.tdx_graph_note_write(
+            self._ptr, op_nr, storage_key & 0xFFFFFFFFFFFFFFFF
+        )
+
+    def __len__(self) -> int:
+        return int(self._lib.tdx_graph_num_nodes(self._ptr))
+
+    def call_stack(self, target_op_nr: int) -> List[int]:
+        # One traversal: the node count bounds the schedule size, so size
+        # the buffer up front instead of a sizing call + a fill call.
+        cap = int(self._lib.tdx_graph_num_nodes(self._ptr))
+        buf = (ctypes.c_int64 * cap)()
+        n = self._lib.tdx_graph_call_stack(self._ptr, target_op_nr, buf, cap)
+        if n < 0:
+            raise KeyError(f"unknown op_nr {target_op_nr}")
+        return list(buf[:n])

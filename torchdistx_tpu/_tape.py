@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import torch
 import torch.utils._pytree as pytree
 
+from . import _native
+
 _tls = threading.local()
 
 # Process-wide chronological op counter (the reference's is thread-local,
@@ -142,6 +144,7 @@ class OpNode:
         "mutated_args",
         "num_outputs",
         "materialized_pyobjs",
+        "native_graph",
         "__weakref__",
     )
 
@@ -178,6 +181,10 @@ class OpNode:
         # Python-identity cache: materializing the same output twice returns
         # the same object (the reference's pyobj reuse, _C/deferred_init.cc:79-93).
         self.materialized_pyobjs: Dict[int, Any] = {}
+        # Native-core graph this node is registered in (None = Python path).
+        # Shared strong handle: the graph must outlive every node that may
+        # be materialized through it, long after the tape is popped.
+        self.native_graph = None
 
     def __repr__(self):
         return f"OpNode({self.op_nr}: {self.op.name})"
@@ -197,6 +204,23 @@ class Tape:
     def __init__(self):
         # storage key -> list of (op_nr, weakref to node) that WROTE it
         self.writers: Dict[int, List[Tuple[int, weakref.ref]]] = {}
+        # Native-core mirror of the graph structure (C++ traversals for
+        # call-stack building).  Per-tape: storage keys are raw addresses
+        # whose lifetime is only pinned within a tape, so a process-global
+        # graph could see reused addresses as false aliases.
+        try:
+            self.native_graph = _native.NativeGraph()
+        except RuntimeError:
+            self.native_graph = None
+
+    def disable_native(self) -> None:
+        """Drop the native mirror (e.g. a cross-tape dependency appeared —
+        its producer lives in another tape's graph, so this graph's
+        traversals would be incomplete)."""
+        if self.native_graph is not None:
+            for node in self.native_graph.nodes.values():
+                node.native_graph = None
+            self.native_graph = None
 
     def note_write(self, storage_key: int, node: OpNode) -> None:
         entries = self.writers.setdefault(storage_key, [])
@@ -279,6 +303,7 @@ def record_op(
     (copyStack, deferred_init.cc:69-100).
     """
     guards: List[ExternalTensorGuard] = []
+    dep_nodes: List[OpNode] = []
 
     def preserve(a):
         if is_fake(a):
@@ -288,6 +313,7 @@ def record_op(
                     "Cannot record an operation on a fake tensor that was "
                     "created outside of a deferred-init context."
                 )
+            dep_nodes.append(rec.node)
             return OutputRef(rec.node, rec.index)
         if isinstance(a, torch.Tensor):
             guards.append(ExternalTensorGuard(a, a._version))
@@ -342,6 +368,22 @@ def record_op(
     for key in set(node.write_storages):
         tape.note_write(key, node)
 
+    # Mirror the structure into the native core (C++ call-stack builder).
+    g = tape.native_graph
+    if g is not None:
+        deps = dep_nodes
+        if any(d.native_graph is not g for d in deps):
+            # Cross-tape dependency: the producer lives in another tape's
+            # graph, so this graph's traversals would be incomplete.
+            tape.disable_native()
+        else:
+            g.add_node(node.op_nr, node)
+            node.native_graph = g
+            for d in deps:
+                g.add_dep(node.op_nr, d.op_nr)
+            for key in set(node.write_storages):
+                g.note_write(node.op_nr, key)
+
     # Point each fake output's record at this node (deferred_init.cc:683-710).
     for idx, out in enumerate(fake_outputs):
         if out is not None:
@@ -359,7 +401,13 @@ def build_call_stack(target: OpNode) -> List[OpNode]:
     transitive dependency closure plus in-place dependents within the
     horizon (collectCallStack, deferred_init.cc:580-621), sorted by
     ``op_nr``.  Self-contained on the node graph — no live tape needed.
+
+    Uses the native core's traversal when this node was recorded into one
+    (identical semantics; tests/test_native_tape.py asserts equality).
     """
+    g = target.native_graph
+    if g is not None:
+        return [g.nodes[nr] for nr in g.call_stack(target.op_nr)]
     horizon = target.op_nr
     for d in target.dependents:
         if d.op_nr > horizon:

@@ -267,6 +267,8 @@ def _named_fakes(module: nn.Module) -> List[Tuple[str, FakeTensor]]:
 def _resolve_spec(plan, name: str, fake: FakeTensor, mesh=None):
     from jax.sharding import PartitionSpec
 
+    from .parallel.sharding import fit_spec_to_mesh, replicate_indivisible
+
     if plan is None:
         return PartitionSpec()
     if callable(plan):
@@ -277,23 +279,9 @@ def _resolve_spec(plan, name: str, fake: FakeTensor, mesh=None):
         return PartitionSpec()
     if mesh is None:
         return spec
-    # Drop axis assignments whose dimension isn't divisible by the axis size
-    # (e.g. a 50257 vocab over tp=4): the sharded-init value would be
-    # ill-defined.  Frameworks that want sharded embeddings pad the vocab;
-    # replicating the odd dimension is the safe materialization default.
-    shape = tuple(fake.shape)
-    entries = list(spec) + [None] * (len(shape) - len(spec))
-    fixed = []
-    for dim, axes in enumerate(entries):
-        if axes is None:
-            fixed.append(None)
-            continue
-        axis_tuple = axes if isinstance(axes, tuple) else (axes,)
-        size = 1
-        for a in axis_tuple:
-            size *= mesh.shape[a]
-        fixed.append(axes if shape[dim] % size == 0 else None)
-    return PartitionSpec(*fixed)
+    return replicate_indivisible(
+        fit_spec_to_mesh(spec, mesh), tuple(fake.shape), mesh
+    )
 
 
 def materialize_tensor_jax(

@@ -1,0 +1,12 @@
+"""TPU-native model stack.
+
+The reference framework ships no models of its own — its BASELINE workloads
+instantiate torchvision / HF models through deferred init.  This framework
+supports that torch-module path (:mod:`torchdistx_tpu.deferred_init`) *and*
+ships JAX-native model families designed for the TPU training stack:
+
+* :mod:`torchdistx_tpu.models.llama` — Llama-2-family decoder (flagship).
+* :mod:`torchdistx_tpu.models.gpt2` — GPT-2 family.
+"""
+
+from . import gpt2, llama  # noqa: F401

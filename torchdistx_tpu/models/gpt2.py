@@ -1,0 +1,234 @@
+"""GPT-2 family, TPU-native (BASELINE config 3's model family).
+
+Same design rules as the flagship (:mod:`torchdistx_tpu.models.llama`):
+stacked layers + ``lax.scan``, bf16 matmuls / f32 reductions, ``(in, out)``
+weight layout, sharding via :func:`param_specs`, remat.  GPT-2 specifics:
+learned positional embeddings, pre-LN with biases, GELU MLP, standard MHA
+(no GQA), logits tied to the token embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention
+
+__all__ = [
+    "GPT2Config",
+    "gpt2_test",
+    "gpt2_small",
+    "gpt2_xl",
+    "init_params",
+    "abstract_params",
+    "param_specs",
+    "forward",
+    "loss_fn",
+    "num_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return 4 * self.dim
+
+
+def gpt2_test() -> GPT2Config:
+    return GPT2Config(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, max_seq_len=128,
+        dtype=jnp.float32, remat=False,
+    )
+
+
+def gpt2_small() -> GPT2Config:
+    return GPT2Config()
+
+
+def gpt2_xl() -> GPT2Config:
+    return GPT2Config(dim=1600, n_layers=48, n_heads=25, max_seq_len=1024)
+
+
+def _shapes(cfg: GPT2Config) -> dict:
+    L, D, F, V, S = (
+        cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.vocab_size, cfg.max_seq_len,
+    )
+    return {
+        "wte": {"weight": (V, D)},
+        "wpe": {"weight": (S, D)},
+        "layers": {
+            "ln_1": {"scale": (L, D), "bias": (L, D)},
+            "attn_qkv": {"weight": (L, D, 3 * D), "bias": (L, 3 * D)},
+            "attn_proj": {"weight": (L, D, D), "bias": (L, D)},
+            "ln_2": {"scale": (L, D), "bias": (L, D)},
+            "mlp_fc": {"weight": (L, D, F), "bias": (L, F)},
+            "mlp_proj": {"weight": (L, F, D), "bias": (L, D)},
+        },
+        "ln_f": {"scale": (D,), "bias": (D,)},
+    }
+
+
+def abstract_params(cfg: GPT2Config):
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s, cfg.dtype),
+        _shapes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def param_specs(
+    cfg: GPT2Config, *, tp: Optional[str] = "tp", fsdp: Optional[str] = "fsdp"
+):
+    """Megatron TP for GPT-2: qkv/fc column-parallel (out dim), proj
+    row-parallel (in dim); embeddings sharded (vocab|seq over fsdp, model
+    dim over tp); norms replicated."""
+    return {
+        "wte": {"weight": P(fsdp, tp)},
+        "wpe": {"weight": P(fsdp, tp)},
+        "layers": {
+            "ln_1": {"scale": P(), "bias": P()},
+            "attn_qkv": {"weight": P(None, fsdp, tp), "bias": P(None, tp)},
+            "attn_proj": {"weight": P(None, tp, fsdp), "bias": P()},
+            "ln_2": {"scale": P(), "bias": P()},
+            "mlp_fc": {"weight": P(None, fsdp, tp), "bias": P(None, tp)},
+            "mlp_proj": {"weight": P(None, tp, fsdp), "bias": P()},
+        },
+        "ln_f": {"scale": P(), "bias": P()},
+    }
+
+
+def init_params(key, cfg: GPT2Config):
+    """GPT-2 init: N(0, 0.02) weights/embeddings, residual projections
+    scaled by 1/sqrt(2·n_layers), zeros biases, ones LN scales."""
+    import zlib
+
+    shapes = _shapes(cfg)
+    resid_scaled = {"attn_proj", "mlp_proj"}
+
+    def leaf(path, shape):
+        name = path[-1]
+        parent = path[-2] if len(path) > 1 else ""
+        if name == "scale":
+            return jnp.ones(shape, dtype=cfg.dtype)
+        if name == "bias":
+            return jnp.zeros(shape, dtype=cfg.dtype)
+        std = 0.02
+        if parent in resid_scaled:
+            std = 0.02 / (2.0 * cfg.n_layers) ** 0.5
+        leaf_key = jax.random.fold_in(key, zlib.crc32("/".join(path).encode()))
+        return (
+            jax.random.normal(leaf_key, shape, dtype=jnp.float32) * std
+        ).astype(cfg.dtype)
+
+    def walk(tree, path=()):
+        if isinstance(tree, tuple):
+            return leaf(path, tree)
+        return {k: walk(v, path + (k,)) for k, v in tree.items()}
+
+    return walk(shapes)
+
+
+def num_params(cfg: GPT2Config) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(
+        _shapes(cfg), is_leaf=lambda x: isinstance(x, tuple)
+    ):
+        n = 1
+        for s in leaf:
+            n *= s
+        total += n
+    return total
+
+
+def _layernorm(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return out.astype(x.dtype) * scale.astype(x.dtype) + bias.astype(x.dtype)
+
+
+def forward(
+    params,
+    tokens,
+    cfg: GPT2Config,
+    *,
+    mesh=None,
+    seq_axis: Optional[str] = None,
+    attn_impl: str = "auto",
+):
+    """Token ids ``(B, S)`` → logits ``(B, S, V)`` (f32, tied embeddings)."""
+    b, s = tokens.shape
+    x = jnp.take(params["wte"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    x = x + params["wpe"]["weight"][:s].astype(cfg.dtype)[None]
+
+    def block(x, lp):
+        h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
+        qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
+            cfg.dtype
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        attn = attention(
+            q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
+        ).reshape(b, s, -1)
+        x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
+            "bias"
+        ].astype(cfg.dtype)
+        h = _layernorm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.norm_eps)
+        h = jax.nn.gelu(
+            h @ lp["mlp_fc"]["weight"] + lp["mlp_fc"]["bias"].astype(cfg.dtype)
+        )
+        x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"]["bias"].astype(
+            cfg.dtype
+        )
+        return x, None
+
+    body = jax.checkpoint(block) if cfg.remat else block
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layernorm(
+        x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
+    )
+    logits = (x @ params["wte"]["weight"].astype(cfg.dtype).T).astype(
+        jnp.float32
+    )
+    return logits
+
+
+def loss_fn(
+    params,
+    tokens,
+    targets,
+    cfg: GPT2Config,
+    *,
+    mesh=None,
+    seq_axis: Optional[str] = None,
+    attn_impl: str = "auto",
+):
+    logits = forward(
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()

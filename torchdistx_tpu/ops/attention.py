@@ -1,0 +1,111 @@
+"""Attention ops: reference implementation + implementation dispatcher.
+
+The reference framework (/root/reference) contains no attention code at all —
+its models come from torchvision/HF (BASELINE configs).  This framework ships
+its own TPU-native model stack (:mod:`torchdistx_tpu.models`), so attention is
+a first-class op with three interchangeable implementations:
+
+* ``"jnp"``     — pure jax.numpy reference (runs anywhere, XLA-fused);
+* ``"pallas"``  — fused flash-attention Pallas TPU kernel
+  (:mod:`torchdistx_tpu.ops.pallas.flash_attention`): O(seq) memory, tiled
+  for the MXU, online softmax;
+* ``"ring"``    — ring attention over a sequence-parallel mesh axis
+  (:mod:`torchdistx_tpu.parallel.ring_attention`): blockwise attention with
+  K/V rotating over ICI via ``ppermute``, for sequences too long for one
+  chip's HBM.
+
+``attention()`` picks automatically: ring iff a sequence-parallel mesh axis
+is given, else pallas on TPU, else jnp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+__all__ = ["attention", "mha_reference"]
+
+
+def _neg_inf(dtype):
+    import jax.numpy as jnp
+
+    return jnp.finfo(dtype).min
+
+
+def mha_reference(q, k, v, *, causal: bool = True, segment_ids=None):
+    """Reference multi-head attention (GQA-aware) in plain jax.numpy.
+
+    Shapes: q ``(B, Sq, Hq, D)``; k/v ``(B, Sk, Hkv, D)`` with
+    ``Hq % Hkv == 0`` (grouped-query attention).  Returns ``(B, Sq, Hq, D)``.
+    Softmax is computed in float32 regardless of input dtype (bfloat16-safe).
+    """
+    import jax.numpy as jnp
+
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    groups = hq // hkv
+    qg = q.reshape(b, sq, hkv, groups, d)
+    scale = 1.0 / (d**0.5)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * scale
+    if causal:
+        # Positions are global: with sequence parallelism the caller passes
+        # pre-offset index vectors via segment_ids=None + explicit masks in
+        # ring_attention; here q and k start at 0.
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(sk)[None, :]
+        mask = qi >= ki
+        logits = jnp.where(mask[None, None, None], logits, _neg_inf(jnp.float32))
+    if segment_ids is not None:
+        q_seg, k_seg = segment_ids
+        mask = q_seg[:, None, None, :, None] == k_seg[:, None, None, None, :]
+        logits = jnp.where(mask, logits, _neg_inf(jnp.float32))
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
+    return out.reshape(b, sq, hq, d)
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    mesh=None,
+    seq_axis: Optional[str] = None,
+):
+    """Dispatching attention entry point used by the model stack.
+
+    ``impl``: ``"auto" | "jnp" | "pallas" | "ring"``.  ``auto`` = ring iff
+    ``seq_axis`` is set (sequence/context parallelism), else pallas on TPU,
+    else jnp.
+    """
+    if impl == "auto":
+        if seq_axis is not None:
+            impl = "ring"
+        elif _on_tpu():
+            impl = "pallas"
+        else:
+            impl = "jnp"
+    if impl == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        if mesh is None or seq_axis is None:
+            raise ValueError("ring attention needs mesh= and seq_axis=")
+        return ring_attention(q, k, v, mesh=mesh, axis=seq_axis, causal=causal)
+    if impl == "pallas":
+        from .pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return mha_reference(q, k, v, causal=causal)

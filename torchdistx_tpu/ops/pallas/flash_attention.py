@@ -1,0 +1,198 @@
+"""Fused flash attention — Pallas TPU kernel.
+
+Single-chip attention for the model stack (:mod:`torchdistx_tpu.models`):
+Q is tiled into blocks that stream through VMEM while the full K/V rows for
+the (kv-)head sit in VMEM; logits/softmax run in float32 on the VPU and both
+matmuls hit the MXU via ``jnp.dot(..., preferred_element_type=f32)``.  GQA is
+handled in the index maps — each Q-head grid step fetches its kv-head's K/V
+block (no materialized head expansion, no extra HBM traffic).
+
+The public entry is differentiable via ``jax.custom_vjp``: the forward runs
+the Pallas kernel (saving the f32 log-sum-exp), the backward uses the
+standard flash-attention gradient identities computed with XLA (dv = pᵀ·do,
+ds = p∘(do·vᵀ − rowsum(do∘o)), dq = ds·k, dk = dsᵀ·q) — exact, recompute-
+based, nothing saved but q/k/v/out/lse.
+
+``interpret=True`` runs the same kernel through the Pallas interpreter so CPU
+CI (the virtual-mesh test rig, SURVEY.md §4) covers the kernel logic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = float("-inf")
+
+
+def _pick_block(s: int, preferred: int = 256) -> int:
+    if s <= preferred:
+        return s
+    b = preferred
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
+    k = k_ref[0, 0].astype(jnp.float32)  # (S, d)
+    v = v_ref[0, 0]  # (S, d)
+    s = k.shape[0]
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, s), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, s), 1)
+        logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        (p / l).astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _fa_forward(q, k, v, *, causal: bool, interpret: bool):
+    """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) → (out, lse)."""
+    import jax.experimental.pallas as pl
+
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    groups = hq // hkv
+    bq = _pick_block(s)
+    scale = 1.0 / (d**0.5)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, hq, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, hq, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _expand_kv(t, groups):
+    # (B, Hkv, S, D) -> (B, Hq, S, D) for the XLA backward.
+    return jnp.repeat(t, groups, axis=1) if groups > 1 else t
+
+
+def _fa_backward_xla(q, k, v, out, lse, do, *, causal, scale):
+    """Exact flash-attention gradients, recomputed in XLA (f32).
+
+    Chunked over Q blocks with a ``lax.scan`` accumulating dk/dv, so peak
+    memory is O(bq·S) logits per head — the same order as the forward
+    kernel — never the full (S, S) attention matrix.
+    """
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    groups = hq // hkv
+    kx = _expand_kv(k, groups).astype(jnp.float32)
+    vx = _expand_kv(v, groups).astype(jnp.float32)
+    bq = _pick_block(s)
+    nblk = s // bq
+
+    def chunk(t):  # (B, H, S, ...) -> (nblk, B, H, bq, ...)
+        return jnp.moveaxis(
+            t.reshape(t.shape[:2] + (nblk, bq) + t.shape[3:]), 2, 0
+        )
+
+    q_c = chunk(q.astype(jnp.float32))
+    do_c = chunk(do.astype(jnp.float32))
+    delta_c = chunk(jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                            axis=-1, keepdims=True))
+    lse_c = chunk(lse[..., None])
+    kpos = jnp.arange(s)
+
+    def step(carry, blk):
+        dk_acc, dv_acc, i = carry
+        qi, doi, di, li = blk
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qi, kx) * scale
+        if causal:
+            qpos = i * bq + jnp.arange(bq)
+            logits = jnp.where(
+                (qpos[:, None] >= kpos[None, :])[None, None], logits, _NEG_INF
+            )
+        p = jnp.exp(logits - li)  # rows sum to 1
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, doi)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", doi, vx)
+        ds = p * (dp - di) * scale
+        dqi = jnp.einsum("bhqk,bhkd->bhqd", ds, kx)
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qi)
+        return (dk_acc, dv_acc, i + 1), dqi
+
+    zeros = jnp.zeros((b, hq, s, d), dtype=jnp.float32)
+    (dk, dv, _), dq_c = jax.lax.scan(
+        step, (zeros, zeros, jnp.zeros((), jnp.int32)),
+        (q_c, do_c, delta_c, lse_c),
+    )
+    dq = jnp.moveaxis(dq_c, 0, 2).reshape(b, hq, s, d)
+    if groups > 1:
+        dk = dk.reshape(b, hkv, groups, s, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, groups, s, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fa(q, k, v, causal, interpret):
+    out, _ = _fa_forward(q, k, v, causal=causal, interpret=interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    out, lse = _fa_forward(q, k, v, causal=causal, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, interpret, res, do):
+    q, k, v, out, lse = res
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _fa_backward_xla(q, k, v, out, lse, do, causal=causal, scale=scale)
+
+
+_fa.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = True, interpret: Optional[bool] = None
+):
+    """Fused attention.  Layout matches the model stack: ``(B, S, H, D)``.
+
+    ``interpret``: force the Pallas interpreter (None = auto: interpret on
+    non-TPU backends so the kernel is testable on the CPU mesh rig).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Kernel layout is (B, H, S, D).
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _fa(qt, kt, vt, causal, interpret)
+    return out.transpose(0, 2, 1, 3)

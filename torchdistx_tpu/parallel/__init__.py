@@ -7,3 +7,7 @@ from .sharding import (  # noqa: F401
     combine_plans,
     replicated_plan,
 )
+
+# JAX-dependent modules (slowmo, ring_attention, train_step) import lazily —
+# `from torchdistx_tpu.parallel import slowmo` etc. — so the torch-only
+# surface (mesh specs, plan builders) stays importable without JAX.

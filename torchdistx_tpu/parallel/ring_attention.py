@@ -1,0 +1,151 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context attention for sequences too large for one chip's HBM: the
+sequence dim of Q/K/V is sharded over the ``sp`` mesh axis; each device keeps
+its Q block resident and the K/V blocks rotate around the ring via
+``lax.ppermute`` (one neighbor hop per step — the collective rides ICI), with
+a numerically stable *online softmax* merging each visiting block's
+contribution (the blockwise-attention recurrence of Ring Attention,
+arXiv:2310.01889).  After ``sp`` steps every Q block has attended to the full
+sequence; peak memory per device is O(S/sp · S/sp) logits instead of O(S²).
+
+Implemented as ``shard_map`` over the mesh + ``lax.scan`` over ring steps, so
+it nests inside the jitted train step and is reverse-differentiable (scan and
+ppermute both transpose); wrap the caller in ``jax.checkpoint`` to avoid
+storing per-step residuals.
+
+The reference framework has no sequence parallelism (SURVEY.md §2.3) — this
+is native new capability shaped by the TPU interconnect.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention"]
+
+_NEG_INF = float("-inf")
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+def _block_contrib(q, k, v, q_off, k_off, causal):
+    """One K/V block's unnormalized contribution (GQA-aware).
+
+    q: (B, Sq, Hq, D); k/v: (B, Sk, Hkv, D).  Returns
+    (num (B,Sq,Hq,D) f32, m (B,Sq,Hq,1) f32, l (B,Sq,Hq,1) f32) where
+    num = exp(logits - m) @ v, m = row max, l = row sum.
+    """
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+    scale = 1.0 / (d**0.5)
+    qg = q.reshape(b, sq, hkv, groups, d)
+    logits = (
+        jnp.einsum("bqhgd,bkhd->bqhgk", qg, k).astype(jnp.float32) * scale
+    )  # (B, Sq, Hkv, G, Sk)
+    if causal:
+        qi = q_off + jnp.arange(sq)
+        ki = k_off + jnp.arange(sk)
+        mask = qi[:, None] >= ki[None, :]
+        logits = jnp.where(mask[None, :, None, None, :], logits, _NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # (B,Sq,Hkv,G,1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe)
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    num = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    m = jnp.where(jnp.isfinite(m), m, _NEG_INF)
+    return (
+        num.reshape(b, sq, hq, d),
+        m.reshape(b, sq, hq, 1),
+        l.reshape(b, sq, hq, 1),
+    )
+
+
+def _merge(acc, blk):
+    """Online-softmax merge of two partial (num, m, l) triples."""
+    num_a, m_a, l_a = acc
+    num_b, m_b, l_b = blk
+    m_new = jnp.maximum(m_a, m_b)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_a), jnp.exp(m_a - m_safe), 0.0)
+    beta = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - m_safe), 0.0)
+    return (num_a * alpha + num_b * beta, m_new, l_a * alpha + l_b * beta)
+
+
+def _ring_body(q, k, v, *, axis: str, causal: bool):
+    """Per-device body under shard_map: local blocks in, local out."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, sl, hq, d = q.shape
+    q_off = idx * sl
+
+    num0 = jnp.zeros((b, sl, hq, d), dtype=jnp.float32)
+    m0 = jnp.full((b, sl, hq, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, sl, hq, 1), dtype=jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, acc = carry
+        src = (idx - t) % n
+        blk = _block_contrib(q, k_blk, v_blk, q_off, src * sl, causal)
+        acc = _merge(acc, blk)
+        k_next = jax.lax.ppermute(k_blk, axis, perm)
+        v_next = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_next, v_next, acc), None
+
+    (_, _, (num, m, l)), _ = jax.lax.scan(
+        step, (k, v, (num0, m0, l0)), jnp.arange(n)
+    )
+    out = num / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    batch_axes: Sequence[str] = ("dp", "fsdp"),
+    head_axes: Sequence[str] = ("tp",),
+):
+    """Sequence-parallel attention.  Layout ``(B, S, H, D)`` (global shapes).
+
+    ``q``/``k``/``v`` are sharded ``P(batch, sp, heads, None)``; the result
+    carries the same sharding.  ``batch_axes``/``head_axes`` name the mesh
+    axes the batch/head dims are sharded over (entries absent from ``mesh``
+    are ignored), so the shard_map composes with dp/fsdp/tp sharding without
+    forcing reshards.
+    """
+    names = set(mesh.axis_names)
+    if axis not in names:
+        raise ValueError(f"mesh has no axis {axis!r} (axes: {mesh.axis_names})")
+    batch = tuple(a for a in batch_axes if a in names) or None
+    heads = tuple(a for a in head_axes if a in names) or None
+    spec = P(batch, axis, heads, None)
+    body = functools.partial(_ring_body, axis=axis, causal=causal)
+    return _shard_map(
+        body, mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

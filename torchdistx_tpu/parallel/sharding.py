@@ -21,6 +21,60 @@ def _pspec(*axes):
     return PartitionSpec(*axes)
 
 
+def fit_spec_to_mesh(spec, mesh):
+    """Drop axis names the mesh doesn't have (e.g. a tp rule on a dp-only
+    mesh) — the single implementation used by the model stack and the train
+    steps."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            return kept or None
+        return entry if entry in names else None
+
+    return _pspec(*[keep(a) for a in spec])
+
+
+def fit_shardings(specs, abstract, mesh):
+    """Spec pytree + abstract (shape) pytree → ``NamedSharding`` pytree,
+    applying :func:`fit_spec_to_mesh` then :func:`replicate_indivisible` to
+    every leaf.  Model-agnostic: used by the train steps and the model
+    families' ``init_sharded``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.tree.map(
+        lambda s, ab: NamedSharding(
+            mesh,
+            replicate_indivisible(fit_spec_to_mesh(s, mesh), ab.shape, mesh),
+        ),
+        specs,
+        abstract,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def replicate_indivisible(spec, shape, mesh):
+    """Replicate dims whose size isn't divisible by their assigned axis
+    product (e.g. a 32000 vocab over tp=7): a sharded init value would be
+    ill-defined.  Frameworks wanting sharded odd dims pad them instead."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    fixed = []
+    for dim, axes in enumerate(entries):
+        if axes is None:
+            fixed.append(None)
+            continue
+        axis_tuple = axes if isinstance(axes, tuple) else (axes,)
+        size = 1
+        for a in axis_tuple:
+            size *= mesh.shape[a]
+        fixed.append(axes if shape[dim] % size == 0 else None)
+    return _pspec(*fixed)
+
+
 def replicated_plan() -> Plan:
     return lambda name, shape: _pspec()
 
@@ -126,15 +180,13 @@ def fsdp_over(base: Plan, axis: str = "fsdp", *, min_size: int = 1024) -> Plan:
 def combine_plans(*plans: Plan) -> Plan:
     """First plan returning a non-None spec wins; else replicated.
 
-    Compose TP rules over an FSDP default:
-    ``combine_plans(tp_plan_llama(), fsdp_plan())`` = 2-D "FSDP + TP".
+    An explicit empty ``PartitionSpec()`` *is* a match ("replicate this
+    param") and stops the search — e.g. a TP rule replicating a norm weight
+    must not be overridden by a later FSDP catch-all.  For genuine 2-D
+    sharding (FSDP over the dims TP left free) use :func:`fsdp_over`.
     """
 
     def plan(name: str, shape: Tuple[int, ...]):
-        for p in plans:
-            spec = p(name, shape)
-            if spec is not None and tuple(spec) != ():
-                return spec
         for p in plans:
             spec = p(name, shape)
             if spec is not None:
