@@ -1,0 +1,117 @@
+"""HF → native bridge: logit equivalence against transformers eager models.
+
+These are the strongest correctness oracles for the native model families:
+the same weights must produce (near-)identical logits.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from torchdistx_tpu.models import convert, gpt2, llama
+
+
+def _np_state_dict(model):
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+class TestGPT2:
+    @pytest.fixture(scope="class")
+    def hf(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        torch.manual_seed(0)
+        config = GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4
+        )
+        model = GPT2LMHeadModel(config).eval()
+        return model, config
+
+    def test_logit_equivalence(self, hf):
+        model, config = hf
+        cfg = convert.gpt2_config_from_hf(
+            config, dtype=jnp.float32, remat=False
+        )
+        params = convert.gpt2_params_from_hf(_np_state_dict(model), cfg)
+        tokens = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(1))
+        with torch.no_grad():
+            ref = model(tokens).logits.numpy()
+        ours = np.asarray(
+            gpt2.forward(params, jnp.asarray(tokens.numpy()), cfg, attn_impl="jnp")
+        )
+        assert np.abs(ref - ours).max() < 2e-3
+
+    def test_from_materialized_arrays(self, hf):
+        """deferred_init(HF) → materialize_module_jax → convert → forward."""
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        import torchdistx_tpu.deferred_init as di
+        from torchdistx_tpu.materialize import materialize_module_jax
+
+        config = GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=4
+        )
+        fake = di.deferred_init(GPT2LMHeadModel, config)
+        arrays = materialize_module_jax(fake)
+        cfg = convert.gpt2_config_from_hf(config, dtype=jnp.float32, remat=False)
+        params = convert.gpt2_params_from_hf(arrays, cfg)
+        logits = gpt2.forward(
+            params, jnp.zeros((1, 8), jnp.int32), cfg, attn_impl="jnp"
+        )
+        assert logits.shape == (1, 8, 128)
+        assert bool(jnp.isfinite(logits).all())
+
+
+class TestLlama:
+    @pytest.fixture(scope="class")
+    def hf(self):
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        torch.manual_seed(0)
+        config = LlamaConfig(
+            vocab_size=128,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=64,
+            attn_implementation="eager",
+        )
+        model = LlamaForCausalLM(config).eval()
+        return model, config
+
+    def test_logit_equivalence(self, hf):
+        model, config = hf
+        cfg = convert.llama_config_from_hf(
+            config, dtype=jnp.float32, remat=False
+        )
+        params = convert.llama_params_from_hf(_np_state_dict(model), cfg)
+        tokens = torch.randint(0, 128, (2, 16), generator=torch.Generator().manual_seed(1))
+        with torch.no_grad():
+            ref = model(tokens).logits.numpy()
+        ours = np.asarray(
+            llama.forward(params, jnp.asarray(tokens.numpy()), cfg, attn_impl="jnp")
+        )
+        assert np.abs(ref - ours).max() < 2e-3
+
+    def test_generate_with_converted_weights(self, hf):
+        from torchdistx_tpu.models.generate import generate
+        import jax
+
+        model, config = hf
+        cfg = convert.llama_config_from_hf(config, dtype=jnp.float32, remat=False)
+        params = convert.llama_params_from_hf(_np_state_dict(model), cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        out = generate(
+            params, prompt, jax.random.PRNGKey(0), model=llama, cfg=cfg,
+            max_new_tokens=4, temperature=0.0,
+        )
+        # HF greedy reference
+        with torch.no_grad():
+            hf_out = model.generate(
+                torch.zeros((1, 4), dtype=torch.long), max_new_tokens=4,
+                do_sample=False,
+            )[0, 4:].numpy()
+        assert np.array_equal(np.asarray(out)[0], hf_out)

@@ -1,0 +1,89 @@
+"""KV-cache decode: cached forward ≡ full forward; generation loop."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchdistx_tpu.models import gpt2, llama
+from torchdistx_tpu.models.generate import generate
+
+
+@pytest.fixture(scope="module", params=["llama", "gpt2"])
+def family(request):
+    if request.param == "llama":
+        cfg = llama.llama_test()
+        return llama, cfg
+    cfg = gpt2.gpt2_test()
+    return gpt2, cfg
+
+
+def test_cached_prefill_matches_forward(family):
+    model, cfg = family
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, cfg.vocab_size)
+    full = model.forward(params, tokens, cfg, attn_impl="jnp")
+    cache = model.init_cache(cfg, 2, 32)
+    cached, _ = model.forward_cached(params, tokens, cfg, cache, 0)
+    assert jnp.allclose(full, cached, atol=1e-4)
+
+
+def test_incremental_decode_matches_forward(family):
+    model, cfg = family
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 12), 0, cfg.vocab_size)
+    full = model.forward(params, tokens, cfg, attn_impl="jnp")
+    # Feed token-by-token through the cache; last-position logits must match.
+    cache = model.init_cache(cfg, 1, 16)
+    outs = []
+    for i in range(12):
+        logits, cache = model.forward_cached(
+            params, tokens[:, i : i + 1], cfg, cache, i
+        )
+        outs.append(logits[:, 0])
+    stacked = jnp.stack(outs, axis=1)
+    assert jnp.allclose(full, stacked, atol=1e-4)
+
+
+def test_generate_greedy_matches_manual(family):
+    model, cfg = family
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, cfg.vocab_size)
+    out = generate(
+        params, prompt, jax.random.PRNGKey(0),
+        model=model, cfg=cfg, max_new_tokens=6, temperature=0.0,
+    )
+    assert out.shape == (2, 6)
+    # Manual greedy rollout with the plain forward.
+    seq = prompt
+    for i in range(6):
+        logits = model.forward(params, seq, cfg, attn_impl="jnp")
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        assert jnp.array_equal(out[:, i], nxt), f"step {i}"
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+
+
+def test_generate_eos_padding(family):
+    model, cfg = family
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((1, 4), dtype=jnp.int32)
+    out = generate(
+        params, prompt, jax.random.PRNGKey(0),
+        model=model, cfg=cfg, max_new_tokens=8, temperature=0.0,
+        eos_id=int(jnp.argmax(
+            model.forward(params, prompt, cfg, attn_impl="jnp")[0, -1]
+        )),
+    )
+    # First sampled token IS the eos: everything after must be eos too.
+    assert bool((out == out[0, 0]).all())
+
+
+def test_generate_sampling_reproducible(family):
+    model, cfg = family
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0, cfg.vocab_size)
+    a = generate(params, prompt, jax.random.PRNGKey(7), model=model, cfg=cfg,
+                 max_new_tokens=5, temperature=0.8, top_k=20)
+    b = generate(params, prompt, jax.random.PRNGKey(7), model=model, cfg=cfg,
+                 max_new_tokens=5, temperature=0.8, top_k=20)
+    assert jnp.array_equal(a, b)
+    assert ((a >= 0) & (a < cfg.vocab_size)).all()

@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe schedule ≡ plain scan, training works."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from torchdistx_tpu.models import gpt2, llama
+from torchdistx_tpu.parallel import train_step as ts
+from torchdistx_tpu.parallel.mesh import MeshSpec, make_mesh
+from torchdistx_tpu.parallel.pipeline import pipeline_forward
+
+
+def test_generic_pipeline_matches_scan():
+    mesh = make_mesh(axis_names=("dp", "pp"), shape=(2, 4))
+    key = jax.random.PRNGKey(0)
+    L, B, D = 8, 4, 16
+    w = jax.random.normal(key, (L, D, D)) * 0.3
+    x = jax.random.normal(jax.random.fold_in(key, 1), (B, D))
+
+    def block(h, wl):
+        return jnp.tanh(h @ wl)
+
+    ref = x
+    for i in range(L):
+        ref = block(ref, w[i])
+
+    out = jax.jit(
+        lambda x, w: pipeline_forward(
+            x, w, block, mesh=mesh, axis="pp", n_microbatches=2
+        )
+    )(x, w)
+    assert jnp.allclose(ref, out, atol=1e-5)
+
+
+def test_pipeline_grads_match_scan():
+    mesh = make_mesh(axis_names=("pp",), shape=(8,))
+    key = jax.random.PRNGKey(0)
+    L, B, D = 8, 4, 8
+    w = jax.random.normal(key, (L, D, D)) * 0.3
+    x = jax.random.normal(jax.random.fold_in(key, 1), (B, D))
+
+    def block(h, wl):
+        return jnp.tanh(h @ wl)
+
+    def loss_scan(w):
+        h, _ = jax.lax.scan(lambda h, wl: (block(h, wl), None), x, w)
+        return (h**2).sum()
+
+    def loss_pp(w):
+        h = pipeline_forward(
+            x, w, block, mesh=mesh, axis="pp", n_microbatches=4
+        )
+        return (h**2).sum()
+
+    g_ref = jax.grad(loss_scan)(w)
+    g_pp = jax.jit(jax.grad(loss_pp))(w)
+    assert jnp.allclose(g_ref, g_pp, atol=1e-4)
+
+
+@pytest.mark.parametrize("model_mod,make_cfg", [
+    (llama, llama.llama_test),
+    (gpt2, gpt2.gpt2_test),
+])
+def test_model_pipeline_forward_matches(model_mod, make_cfg):
+    import dataclasses
+
+    cfg = dataclasses.replace(make_cfg(), n_layers=4)
+    mesh = make_mesh(axis_names=("fsdp", "pp"), shape=(2, 4))
+    params = model_mod.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    ref = model_mod.forward(params, tokens, cfg, attn_impl="jnp")
+    out = jax.jit(
+        lambda p, t: model_mod.forward(
+            p, t, cfg, attn_impl="jnp", mesh=mesh, pp_axis="pp",
+            n_microbatches=2,
+        )
+    )(params, tokens)
+    assert jnp.allclose(ref, out, atol=1e-4)
+
+
+def test_pipeline_train_step():
+    import dataclasses
+
+    cfg = dataclasses.replace(llama.llama_test(), n_layers=4)
+    mesh = make_mesh(axis_names=("tp", "pp"), shape=(2, 4))
+    init_fn, step_fn = ts.make_train_step(
+        cfg, mesh, optax.sgd(0.1), pp_axis="pp", n_microbatches=2,
+        attn_impl="jnp",
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    # layer dim sharded over pp
+    assert state.params["layers"]["wq"].sharding.spec[0] == "pp"
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size),
+        ts.batch_sharding(mesh),
+    )
+    batch = {"tokens": tokens, "targets": tokens}
+    losses = []
+    for _ in range(3):
+        state, m = step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]

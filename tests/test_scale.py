@@ -1,0 +1,118 @@
+"""North-star scale validation on a single host (BASELINE configs 4-5).
+
+A Llama-7B-shaped HF model is constructed under deferred init — the full
+architecture must be inspectable with near-zero memory, the tape must be
+fully JAX-lowerable (so sharded materialization would run without torch
+fallbacks), and the whole thing must stay within tight host-RSS bounds.
+Actual materialization is executed at a scaled-down size; the 7B/70B
+materialization itself needs real pod HBM.
+"""
+
+import resource
+
+import pytest
+import torch
+
+import torchdistx_tpu.deferred_init as di
+from torchdistx_tpu import _tape
+from torchdistx_tpu.deferred_init import _get_record
+from torchdistx_tpu.fake import is_fake
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+@pytest.fixture(scope="module")
+def llama7b_fake():
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    config = LlamaConfig()  # defaults = 7B shapes
+    rss0 = _rss_mb()
+    model = di.deferred_init(LlamaForCausalLM, config)
+    return model, config, _rss_mb() - rss0
+
+
+def test_7b_constructs_with_bounded_rss(llama7b_fake):
+    model, config, growth = llama7b_fake
+    # 7B params in fp32 would be ~27 GB; the fake build must stay in the
+    # tens of MBs (tape + meta shadows only).
+    assert growth < 500, f"RSS grew {growth:.0f} MB during fake construction"
+    n_params = sum(p.numel() for p in model.parameters())
+    assert n_params > 6.5e9
+    assert all(is_fake(p) for p in model.parameters())
+
+
+def test_7b_architecture_inspectable(llama7b_fake):
+    model, config, _ = llama7b_fake
+    # The shard-then-materialize flow needs full shape/dtype visibility.
+    shapes = {n: tuple(p.shape) for n, p in model.named_parameters()}
+    assert shapes["model.embed_tokens.weight"] == (32000, 4096)
+    assert shapes["model.layers.31.mlp.down_proj.weight"] == (4096, 11008)
+
+
+def test_7b_tape_fully_jax_lowerable(llama7b_fake):
+    """Every non-view node in every param's call stack must have a JAX
+    lowering — i.e. sharded materialization runs with zero torch fallback
+    and zero CUDA calls (the north-star requirement)."""
+    from torchdistx_tpu.materialize import _is_view_node, _packet_name
+    from torchdistx_tpu.ops.aten_jax import LOWERINGS
+
+    model, config, _ = llama7b_fake
+    missing = set()
+    for _, p in model.named_parameters():
+        node = _get_record(p).node
+        for n in _tape.build_call_stack(node):
+            if _is_view_node(n):
+                continue
+            name = _packet_name(n.op.func)
+            if name not in LOWERINGS:
+                missing.add(name)
+    assert not missing, f"ops without JAX lowering: {sorted(missing)}"
+
+
+def test_7b_native_graph_schedules(llama7b_fake):
+    """The C++ core must schedule the 7B tape (hundreds of nodes) quickly
+    and consistently with chronological order."""
+    model, config, _ = llama7b_fake
+    total = 0
+    for _, p in model.named_parameters():
+        stack = _tape.build_call_stack(_get_record(p).node)
+        nrs = [n.op_nr for n in stack]
+        assert nrs == sorted(nrs)
+        total += len(stack)
+    assert total > 0
+
+
+def test_scaled_down_materialization_is_exact():
+    """Execute the same flow at small scale and check real values: sharded
+    JAX materialization of an HF Llama must match torch replay statistics
+    (RNG differs by design, structure/zeros must match exactly)."""
+    import jax
+    import numpy as np
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from torchdistx_tpu.materialize import materialize_module_jax
+    from torchdistx_tpu.parallel import MeshSpec, make_mesh
+    from torchdistx_tpu.parallel.sharding import combine_plans, fsdp_plan, tp_plan_llama
+
+    config = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    model = di.deferred_init(LlamaForCausalLM, config)
+    mesh = make_mesh(MeshSpec(fsdp=2, tp=4))
+    arrays = materialize_module_jax(
+        model, mesh=mesh, plan=combine_plans(tp_plan_llama(), fsdp_plan())
+    )
+    # Norm weights init to ones exactly; projections are random but bounded.
+    norm = np.asarray(arrays["model.norm.weight"])
+    assert np.array_equal(norm, np.ones_like(norm))
+    q = np.asarray(arrays["model.layers.0.self_attn.q_proj.weight"])
+    assert q.std() < 1.0 and q.std() > 0.001
+    # Every param plus the deferred rotary inv_freq buffer materializes.
+    n_expected = len(list(model.named_parameters())) + sum(
+        1 for _, b in model.named_buffers() if is_fake(b)
+    )
+    assert len(arrays) == n_expected

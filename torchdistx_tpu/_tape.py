@@ -44,6 +44,13 @@ _tls = threading.local()
 # caches / PRNG streams are keyed by op_nr.
 _op_counter = itertools.count()
 
+# Tape sequence numbers: the JAX materializer keys RNG as
+# fold_in(fold_in(seed, tape_seq), op_nr - tape_base) — *relative* op
+# numbers, so the same architecture recorded in any process produces the
+# same init program (HLO-stable → XLA persistent-cache hits), while the
+# tape_seq term keeps streams from colliding across tapes in one process.
+_tape_counter = itertools.count()
+
 
 class OutputRef:
     """Marker replacing a fake-tensor argument inside a recorded arg stack.
@@ -145,6 +152,8 @@ class OpNode:
         "num_outputs",
         "materialized_pyobjs",
         "native_graph",
+        "tape_seq",
+        "base_nr",
         "__weakref__",
     )
 
@@ -185,6 +194,9 @@ class OpNode:
         # Shared strong handle: the graph must outlive every node that may
         # be materialized through it, long after the tape is popped.
         self.native_graph = None
+        # RNG stream identity (see _tape_counter note): set by record_op.
+        self.tape_seq = 0
+        self.base_nr = 0
 
     def __repr__(self):
         return f"OpNode({self.op_nr}: {self.op.name})"
@@ -204,6 +216,8 @@ class Tape:
     def __init__(self):
         # storage key -> list of (op_nr, weakref to node) that WROTE it
         self.writers: Dict[int, List[Tuple[int, weakref.ref]]] = {}
+        self.seq = next(_tape_counter)
+        self.base_nr: Optional[int] = None  # first recorded op_nr
         # Native-core mirror of the graph structure (C++ traversals for
         # call-stack building).  Per-tape: storage keys are raw addresses
         # whose lifetime is only pinned within a tape, so a process-global

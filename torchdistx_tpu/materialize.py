@@ -284,6 +284,12 @@ def _resolve_spec(plan, name: str, fake: FakeTensor, mesh=None):
     )
 
 
+def _base_key(seed: int, rng_impl: str):
+    import jax
+
+    return jax.random.key(seed, impl=rng_impl)
+
+
 def materialize_tensor_jax(
     tensor: torch.Tensor,
     *,
@@ -291,8 +297,15 @@ def materialize_tensor_jax(
     spec=None,
     seed: int = 0,
     dtype: Optional[torch.dtype] = None,
+    rng_impl: str = "threefry2x32",
 ):
-    """Materialize one fake tensor as a ``jax.Array`` (optionally sharded)."""
+    """Materialize one fake tensor as a ``jax.Array`` (optionally sharded).
+
+    ``rng_impl``: ``"threefry2x32"`` (default — bitwise stable across
+    topologies/shardings, the multi-host guarantee) or ``"rbg"`` (XLA
+    RngBitGenerator — much cheaper to compile, for single-chip or
+    throwaway-init use; values may depend on backend/sharding).
+    """
     import jax
 
     record = _get_record(tensor) if isinstance(tensor, FakeTensor) else None
@@ -302,7 +315,7 @@ def materialize_tensor_jax(
     target_dtype = jnp_dtype_of(dtype or tensor.dtype)
 
     def compute():
-        eng = _FunctionalReplay(jax.random.PRNGKey(seed), check_guards=False)
+        eng = _FunctionalReplay(_base_key(seed, rng_impl), check_guards=False)
         eng.run_call_stack(record.node)
         return eng.value_of_output(record.node, record.index).astype(
             target_dtype
@@ -331,6 +344,7 @@ def materialize_module_jax(
     plan: Optional[Any] = None,
     seed: int = 0,
     dtype: Optional[torch.dtype] = None,
+    rng_impl: str = "threefry2x32",
     _fallback_torch: bool = True,
 ) -> Dict[str, Any]:
     """Materialize every fake param/buffer of ``module`` as JAX arrays.
@@ -343,7 +357,8 @@ def materialize_module_jax(
     callable ``(name, shape) -> PartitionSpec | None`` (see
     :mod:`torchdistx_tpu.parallel.sharding` for FSDP/TP plan builders).
     ``dtype``: optional cast applied to every leaf (e.g. ``torch.bfloat16``
-    for TPU training).
+    for TPU training).  ``rng_impl``: see :func:`materialize_tensor_jax`
+    (``"rbg"`` roughly halves XLA compile time for init-heavy tapes).
     """
     import jax
 
@@ -376,7 +391,7 @@ def materialize_module_jax(
     }
 
     def compute():
-        eng = _FunctionalReplay(jax.random.PRNGKey(seed), check_guards=False)
+        eng = _FunctionalReplay(_base_key(seed, rng_impl), check_guards=False)
         # Union of all targets' call stacks, replayed once in global
         # chronological order: a per-target replay could advance a shared
         # storage past an earlier target's read point (write-after-read

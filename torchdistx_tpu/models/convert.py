@@ -1,0 +1,180 @@
+"""HF-checkpoint → native parameter bridge.
+
+Closes the loop between the deferred-init world and the native training
+stack: construct a HF model under ``deferred_init`` (zero allocation),
+materialize its parameters as sharded ``jax.Array``s
+(:func:`torchdistx_tpu.materialize.materialize_module_jax`), then convert
+the flat ``{qualified_name: array}`` dict into the stacked-layer pytrees the
+native model families (:mod:`~torchdistx_tpu.models.llama`,
+:mod:`~torchdistx_tpu.models.gpt2`) train and decode with.
+
+Layout notes:
+
+* HF GPT-2 uses Conv1D — weights already ``(in, out)``, no transpose.
+* HF Llama uses ``nn.Linear`` — weights ``(out, in)``, transposed here.
+* RoPE half-split convention matches between HF Llama and
+  :func:`llama._rope` (verified by the logit-equivalence tests).
+* Layer stacking: per-layer leaves are stacked on a new leading axis in
+  layer order, matching the ``lax.scan`` layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from . import gpt2 as gpt2_mod
+from . import llama as llama_mod
+
+__all__ = [
+    "gpt2_config_from_hf",
+    "llama_config_from_hf",
+    "gpt2_params_from_hf",
+    "llama_params_from_hf",
+]
+
+
+def gpt2_config_from_hf(hf_config, **overrides) -> gpt2_mod.GPT2Config:
+    return gpt2_mod.GPT2Config(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        max_seq_len=hf_config.n_positions,
+        norm_eps=hf_config.layer_norm_epsilon,
+        **overrides,
+    )
+
+
+def llama_config_from_hf(hf_config, **overrides) -> llama_mod.LlamaConfig:
+    return llama_mod.LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        ffn_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=hf_config.rms_norm_eps,
+        **overrides,
+    )
+
+
+def _get(arrays: Dict[str, Any], name: str, *, prefixes=("", "transformer.",
+                                                         "model.")):
+    for p in prefixes:
+        if p + name in arrays:
+            return jnp.asarray(arrays[p + name])
+    raise KeyError(
+        f"parameter '{name}' not found (tried prefixes {list(prefixes)}); "
+        f"have e.g. {sorted(arrays)[:5]}"
+    )
+
+
+def _stack(arrays, fmt: str, n_layers: int, *, transpose=False):
+    leaves = []
+    for i in range(n_layers):
+        a = _get(arrays, fmt.format(i=i))
+        leaves.append(a.T if transpose else a)
+    return jnp.stack(leaves)
+
+
+def gpt2_params_from_hf(
+    arrays: Dict[str, Any], cfg: Optional[gpt2_mod.GPT2Config] = None
+):
+    """Flat HF GPT-2 param dict → native stacked pytree.
+
+    ``arrays``: ``{name: array-like}`` — the output of
+    ``materialize_module_jax(GPT2LMHeadModel-instance)``, a torch
+    ``state_dict()`` (tensors converted via ``numpy()``), or any mix.
+    """
+    L = cfg.n_layers if cfg is not None else _count_layers(arrays, "h.{i}.ln_1.weight")
+    return {
+        "wte": {"weight": _get(arrays, "wte.weight")},
+        "wpe": {"weight": _get(arrays, "wpe.weight")},
+        "layers": {
+            "ln_1": {
+                "scale": _stack(arrays, "h.{i}.ln_1.weight", L),
+                "bias": _stack(arrays, "h.{i}.ln_1.bias", L),
+            },
+            "attn_qkv": {
+                "weight": _stack(arrays, "h.{i}.attn.c_attn.weight", L),
+                "bias": _stack(arrays, "h.{i}.attn.c_attn.bias", L),
+            },
+            "attn_proj": {
+                "weight": _stack(arrays, "h.{i}.attn.c_proj.weight", L),
+                "bias": _stack(arrays, "h.{i}.attn.c_proj.bias", L),
+            },
+            "ln_2": {
+                "scale": _stack(arrays, "h.{i}.ln_2.weight", L),
+                "bias": _stack(arrays, "h.{i}.ln_2.bias", L),
+            },
+            "mlp_fc": {
+                "weight": _stack(arrays, "h.{i}.mlp.c_fc.weight", L),
+                "bias": _stack(arrays, "h.{i}.mlp.c_fc.bias", L),
+            },
+            "mlp_proj": {
+                "weight": _stack(arrays, "h.{i}.mlp.c_proj.weight", L),
+                "bias": _stack(arrays, "h.{i}.mlp.c_proj.bias", L),
+            },
+        },
+        "ln_f": {
+            "scale": _get(arrays, "ln_f.weight"),
+            "bias": _get(arrays, "ln_f.bias"),
+        },
+    }
+
+
+def llama_params_from_hf(
+    arrays: Dict[str, Any], cfg: Optional[llama_mod.LlamaConfig] = None
+):
+    """Flat HF Llama param dict → native stacked pytree (linears
+    transposed to ``(in, out)``)."""
+    L = (
+        cfg.n_layers
+        if cfg is not None
+        else _count_layers(arrays, "layers.{i}.input_layernorm.weight")
+    )
+    lm_head = (
+        _get(arrays, "lm_head.weight")
+        if any(k.endswith("lm_head.weight") for k in arrays)
+        else _get(arrays, "embed_tokens.weight")
+    )
+    return {
+        "embed": {"weight": _get(arrays, "embed_tokens.weight")},
+        "layers": {
+            "attn_norm": _stack(arrays, "layers.{i}.input_layernorm.weight", L),
+            "wq": _stack(arrays, "layers.{i}.self_attn.q_proj.weight", L,
+                         transpose=True),
+            "wk": _stack(arrays, "layers.{i}.self_attn.k_proj.weight", L,
+                         transpose=True),
+            "wv": _stack(arrays, "layers.{i}.self_attn.v_proj.weight", L,
+                         transpose=True),
+            "wo": _stack(arrays, "layers.{i}.self_attn.o_proj.weight", L,
+                         transpose=True),
+            "mlp_norm": _stack(
+                arrays, "layers.{i}.post_attention_layernorm.weight", L
+            ),
+            "w_gate": _stack(arrays, "layers.{i}.mlp.gate_proj.weight", L,
+                             transpose=True),
+            "w_up": _stack(arrays, "layers.{i}.mlp.up_proj.weight", L,
+                           transpose=True),
+            "w_down": _stack(arrays, "layers.{i}.mlp.down_proj.weight", L,
+                             transpose=True),
+        },
+        "norm": {"weight": _get(arrays, "norm.weight")},
+        "lm_head": {"weight": lm_head.T},
+    }
+
+
+def _count_layers(arrays, fmt: str) -> int:
+    i = 0
+    while True:
+        name = fmt.format(i=i)
+        if not any(k.endswith(name) for k in arrays):
+            return i
+        i += 1

@@ -96,21 +96,26 @@ def abstract_params(cfg: GPT2Config):
 
 
 def param_specs(
-    cfg: GPT2Config, *, tp: Optional[str] = "tp", fsdp: Optional[str] = "fsdp"
+    cfg: GPT2Config,
+    *,
+    tp: Optional[str] = "tp",
+    fsdp: Optional[str] = "fsdp",
+    pp: Optional[str] = None,
 ):
     """Megatron TP for GPT-2: qkv/fc column-parallel (out dim), proj
     row-parallel (in dim); embeddings sharded (vocab|seq over fsdp, model
-    dim over tp); norms replicated."""
+    dim over tp); norms replicated; ``pp`` shards the layer dim into
+    pipeline stages."""
     return {
         "wte": {"weight": P(fsdp, tp)},
         "wpe": {"weight": P(fsdp, tp)},
         "layers": {
-            "ln_1": {"scale": P(), "bias": P()},
-            "attn_qkv": {"weight": P(None, fsdp, tp), "bias": P(None, tp)},
-            "attn_proj": {"weight": P(None, tp, fsdp), "bias": P()},
-            "ln_2": {"scale": P(), "bias": P()},
-            "mlp_fc": {"weight": P(None, fsdp, tp), "bias": P(None, tp)},
-            "mlp_proj": {"weight": P(None, tp, fsdp), "bias": P()},
+            "ln_1": {"scale": P(pp), "bias": P(pp)},
+            "attn_qkv": {"weight": P(pp, fsdp, tp), "bias": P(pp, tp)},
+            "attn_proj": {"weight": P(pp, tp, fsdp), "bias": P(pp)},
+            "ln_2": {"scale": P(pp), "bias": P(pp)},
+            "mlp_fc": {"weight": P(pp, fsdp, tp), "bias": P(pp, tp)},
+            "mlp_proj": {"weight": P(pp, tp, fsdp), "bias": P(pp)},
         },
         "ln_f": {"scale": P(), "bias": P()},
     }
@@ -175,6 +180,8 @@ def forward(
     mesh=None,
     seq_axis: Optional[str] = None,
     attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
 ):
     """Token ids ``(B, S)`` → logits ``(B, S, V)`` (f32, tied embeddings)."""
     b, s = tokens.shape
@@ -182,17 +189,18 @@ def forward(
     x = x + params["wpe"]["weight"][:s].astype(cfg.dtype)[None]
 
     def block(x, lp):
+        bb = x.shape[0]
         h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
         qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
             cfg.dtype
         )
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        q = q.reshape(bb, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(bb, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(bb, s, cfg.n_heads, cfg.head_dim)
         attn = attention(
             q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
-        ).reshape(b, s, -1)
+        ).reshape(bb, s, -1)
         x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
             "bias"
         ].astype(cfg.dtype)
@@ -203,10 +211,19 @@ def forward(
         x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"]["bias"].astype(
             cfg.dtype
         )
-        return x, None
+        return x
 
     body = jax.checkpoint(block) if cfg.remat else block
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    if pp_axis is not None:
+        from ..parallel.pipeline import pipeline_forward
+
+        x = pipeline_forward(
+            x, params["layers"], body, mesh=mesh, axis=pp_axis,
+            n_microbatches=n_microbatches,
+        )
+    else:
+        x, _ = jax.lax.scan(lambda h, lp: (body(h, lp), None), x,
+                            params["layers"])
     x = _layernorm(
         x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
     )
@@ -214,6 +231,63 @@ def forward(
         jnp.float32
     )
     return logits
+
+
+def init_cache(cfg: GPT2Config, batch: int, max_len: int):
+    """Static-shape KV cache: ``(L, B, Smax, H, Dh)`` per k/v."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
+    """Incremental forward (see :func:`llama.forward_cached`)."""
+    from ..ops.attention import cached_attention
+
+    b, t = tokens.shape
+    x = jnp.take(params["wte"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    pos_ids = pos + jnp.arange(t)
+    x = x + jnp.take(params["wpe"]["weight"], pos_ids, axis=0).astype(
+        cfg.dtype
+    )[None]
+
+    def block(x, layer):
+        lp, k_cache, v_cache = layer
+        h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
+        qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
+            cfg.dtype
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        attn = cached_attention(q, k_cache, v_cache, pos).reshape(b, t, -1)
+        x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
+            "bias"
+        ].astype(cfg.dtype)
+        h = _layernorm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.norm_eps)
+        h = jax.nn.gelu(
+            h @ lp["mlp_fc"]["weight"] + lp["mlp_fc"]["bias"].astype(cfg.dtype)
+        )
+        x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"]["bias"].astype(
+            cfg.dtype
+        )
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _layernorm(
+        x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
+    )
+    logits = (x @ params["wte"]["weight"].astype(cfg.dtype).T).astype(
+        jnp.float32
+    )
+    return logits, {"k": new_k, "v": new_v}
 
 
 def loss_fn(
@@ -225,9 +299,12 @@ def loss_fn(
     mesh=None,
     seq_axis: Optional[str] = None,
     attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
 ):
     logits = forward(
-        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl,
+        pp_axis=pp_axis, n_microbatches=n_microbatches,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

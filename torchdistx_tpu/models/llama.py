@@ -152,7 +152,11 @@ def abstract_params(cfg: LlamaConfig):
 
 
 def param_specs(
-    cfg: LlamaConfig, *, tp: Optional[str] = "tp", fsdp: Optional[str] = "fsdp"
+    cfg: LlamaConfig,
+    *,
+    tp: Optional[str] = "tp",
+    fsdp: Optional[str] = "fsdp",
+    pp: Optional[str] = None,
 ):
     """Megatron-TP + FSDP partition specs matching :func:`abstract_params`.
 
@@ -160,19 +164,20 @@ def param_specs(
     over ``tp``; row-parallel (wo/w_down) shard their *in* dim, so the pair
     needs exactly one ``psum`` per block (the classic Megatron layout).  The
     other large dim shards over ``fsdp`` (ZeRO-3).  Norms replicate.
+    ``pp`` (if given) shards the stacked layer dim into pipeline stages.
     """
     return {
         "embed": {"weight": P(fsdp, tp)},
         "layers": {
-            "attn_norm": P(),
-            "wq": P(None, fsdp, tp),
-            "wk": P(None, fsdp, tp),
-            "wv": P(None, fsdp, tp),
-            "wo": P(None, tp, fsdp),
-            "mlp_norm": P(),
-            "w_gate": P(None, fsdp, tp),
-            "w_up": P(None, fsdp, tp),
-            "w_down": P(None, tp, fsdp),
+            "attn_norm": P(pp),
+            "wq": P(pp, fsdp, tp),
+            "wk": P(pp, fsdp, tp),
+            "wv": P(pp, fsdp, tp),
+            "wo": P(pp, tp, fsdp),
+            "mlp_norm": P(pp),
+            "w_gate": P(pp, fsdp, tp),
+            "w_up": P(pp, fsdp, tp),
+            "w_down": P(pp, tp, fsdp),
         },
         "norm": {"weight": P()},
         "lm_head": {"weight": P(fsdp, tp)},
@@ -279,41 +284,106 @@ def forward(
     mesh=None,
     seq_axis: Optional[str] = None,
     attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
 ):
     """Token ids ``(B, S)`` → logits ``(B, S, V)`` (float32).
 
     Sharding-agnostic: run it under ``jit`` with sharded params/tokens and
     XLA partitions it.  ``seq_axis`` switches attention to the ring
     implementation over that mesh axis (sequence/context parallelism for
-    long sequences).
+    long sequences).  ``pp_axis`` runs the transformer blocks through the
+    GPipe pipeline (:mod:`torchdistx_tpu.parallel.pipeline`) with
+    ``n_microbatches`` microbatches (pp composes with tp/fsdp; use jnp or
+    pallas attention inside the pipeline, not ring).
     """
     b, s = tokens.shape
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
-    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    # (1, S): broadcasts over any (micro)batch size.
+    positions = jnp.arange(s)[None]
 
     def block(x, lp):
+        bb = x.shape[0]
         h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = (h @ lp["wq"]).reshape(bb, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         attn = attention(
             q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
         )
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + attn.reshape(bb, s, -1) @ lp["wo"]
         h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
         x = x + gated @ lp["w_down"]
-        return x, None
+        return x
 
     body = jax.checkpoint(block) if cfg.remat else block
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    if pp_axis is not None:
+        from ..parallel.pipeline import pipeline_forward
+
+        x = pipeline_forward(
+            x, params["layers"], body, mesh=mesh, axis=pp_axis,
+            n_microbatches=n_microbatches,
+        )
+    else:
+        x, _ = jax.lax.scan(lambda h, lp: (body(h, lp), None), x,
+                            params["layers"])
     x = _rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
     logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
         jnp.float32
     )
     return logits
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int):
+    """Static-shape KV cache: ``(L, B, Smax, Hkv, Dh)`` per k/v."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def forward_cached(params, tokens, cfg: LlamaConfig, cache, pos):
+    """Incremental forward: ``tokens (B, T)`` at positions ``pos..pos+T-1``.
+
+    Returns ``(logits (B, T, V) f32, new_cache)``.  One compiled program
+    serves both prefill (T = prompt length) and decode (T = 1) — shapes are
+    static, ``pos`` is a traced scalar.
+    """
+    from ..ops.attention import cached_attention
+
+    b, t = tokens.shape
+    x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    positions = jnp.broadcast_to(pos + jnp.arange(t), (b, t))
+
+    def block(x, layer):
+        lp, k_cache, v_cache = layer
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        attn = cached_attention(q, k_cache, v_cache, pos)
+        x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        x = x + gated @ lp["w_down"]
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
+        jnp.float32
+    )
+    return logits, {"k": new_k, "v": new_v}
 
 
 def loss_fn(
@@ -325,10 +395,13 @@ def loss_fn(
     mesh=None,
     seq_axis: Optional[str] = None,
     attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
 ):
     """Mean next-token cross-entropy (float32)."""
     logits = forward(
-        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl,
+        pp_axis=pp_axis, n_microbatches=n_microbatches,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,275 @@
+"""Mixture-of-Experts Llama variant — expert parallelism over an ``ep`` axis.
+
+Llama blocks with the dense FFN replaced by a top-k-routed expert FFN
+(Switch/Mixtral style): a router scores E experts per token, the top-k are
+selected with renormalized gates, tokens are dispatched into fixed-capacity
+per-expert buffers (static shapes — the TPU requirement), expert FFNs run
+batched over the expert dim, and outputs are combined gate-weighted.
+Tokens over capacity are dropped (standard capacity-factor semantics).
+
+Sharding: expert weights ``(L, E, D, F)`` carry ``P(None, "ep", fsdp, tp)``
+and the dispatch buffers ``(E, C, D)`` shard over ``ep`` — XLA's SPMD
+partitioner turns the dispatch/combine einsums into all-to-alls over the
+``ep`` axis, which is exactly expert parallelism.  A load-balancing aux loss
+(Switch Transformer eq. 4) keeps routing uniform.
+
+The reference framework has no MoE (SURVEY.md §2.3: EP "not required") —
+native new capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention
+from . import llama as llama_mod
+
+__all__ = [
+    "MoEConfig",
+    "moe_test",
+    "init_params",
+    "abstract_params",
+    "param_specs",
+    "forward",
+    "loss_fn",
+    "num_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama_mod.LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+
+def moe_test() -> MoEConfig:
+    return MoEConfig(
+        vocab_size=256,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq_len=128,
+        dtype=jnp.float32,
+        remat=False,
+        n_experts=4,
+        experts_per_token=2,
+    )
+
+
+def _shapes(cfg: MoEConfig) -> dict:
+    base = llama_mod._shapes(cfg)
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_experts
+    base["layers"].pop("w_gate")
+    base["layers"].pop("w_up")
+    base["layers"].pop("w_down")
+    base["layers"]["router"] = (L, D, E)
+    base["layers"]["e_gate"] = (L, E, D, F)
+    base["layers"]["e_up"] = (L, E, D, F)
+    base["layers"]["e_down"] = (L, E, F, D)
+    return base
+
+
+def abstract_params(cfg: MoEConfig):
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s, cfg.dtype),
+        _shapes(cfg),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def param_specs(
+    cfg: MoEConfig,
+    *,
+    tp: Optional[str] = "tp",
+    fsdp: Optional[str] = "fsdp",
+    pp: Optional[str] = None,
+    ep: Optional[str] = "ep",
+):
+    base = llama_mod.param_specs(cfg, tp=tp, fsdp=fsdp, pp=pp)
+    for k in ("w_gate", "w_up", "w_down"):
+        base["layers"].pop(k)
+    base["layers"]["router"] = P(pp)
+    base["layers"]["e_gate"] = P(pp, ep, fsdp, tp)
+    base["layers"]["e_up"] = P(pp, ep, fsdp, tp)
+    base["layers"]["e_down"] = P(pp, ep, tp, fsdp)
+    return base
+
+
+def init_params(key, cfg: MoEConfig):
+    import zlib
+
+    shapes = _shapes(cfg)
+
+    def leaf(path, shape):
+        name = path[-1]
+        if name in ("attn_norm", "mlp_norm") or path[0] == "norm":
+            return jnp.ones(shape, dtype=cfg.dtype)
+        std = 0.02
+        if name in ("wo", "e_down"):
+            std = 0.02 / (2.0 * cfg.n_layers) ** 0.5
+        leaf_key = jax.random.fold_in(key, zlib.crc32("/".join(path).encode()))
+        return (
+            jax.random.normal(leaf_key, shape, dtype=jnp.float32) * std
+        ).astype(cfg.dtype)
+
+    def walk(tree, path=()):
+        if isinstance(tree, tuple):
+            return leaf(path, tree)
+        return {k: walk(v, path + (k,)) for k, v in tree.items()}
+
+    return walk(shapes)
+
+
+def num_params(cfg: MoEConfig) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(
+        _shapes(cfg), is_leaf=lambda x: isinstance(x, tuple)
+    ):
+        n = 1
+        for s in leaf:
+            n *= s
+        total += n
+    return total
+
+
+def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    import math
+
+    cap = math.ceil(
+        cfg.capacity_factor * n_tokens * cfg.experts_per_token / cfg.n_experts
+    )
+    return max(int(cap), 1)
+
+
+def moe_ffn(h, router_w, e_gate, e_up, e_down, cfg: MoEConfig):
+    """Top-k routed expert FFN.  h ``(B, S, D)`` → (out ``(B, S, D)``,
+    aux_loss scalar)."""
+    b, s, d = h.shape
+    t = b * s
+    e, k = cfg.n_experts, cfg.experts_per_token
+    cap = _capacity(cfg, t)
+    ht = h.reshape(t, d)
+
+    router_logits = (ht @ router_w).astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (T, K)
+    gate_vals = gate_vals / gate_vals.sum(axis=-1, keepdims=True)
+
+    # Position of each (token, choice) inside its expert's buffer: running
+    # count of prior selections of the same expert, token-major order.
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)  # (T, K, E)
+    flat = onehot.reshape(t * k, e)
+    pos = (jnp.cumsum(flat, axis=0) - 1)  # (T*K, E)
+    pos = (pos * flat).sum(-1)  # (T*K,)
+    expert_flat = gate_idx.reshape(t * k)
+    keep = pos < cap
+    pos_c = jnp.clip(pos, 0, cap - 1)
+
+    # Dispatch: (E, C, D) buffers.
+    tok_idx = jnp.repeat(jnp.arange(t), k)
+    contrib = ht[tok_idx] * keep[:, None].astype(ht.dtype)
+    dispatch = jnp.zeros((e, cap, d), dtype=ht.dtype).at[
+        expert_flat, pos_c
+    ].add(contrib)
+
+    # Batched expert FFN on the MXU: (E, C, D) @ (E, D, F).
+    gated = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatch, e_gate))
+    up = jnp.einsum("ecd,edf->ecf", dispatch, e_up)
+    expert_out = jnp.einsum("ecf,efd->ecd", gated * up, e_down)
+
+    # Combine: gather each choice's output, gate-weight, sum over k.
+    out_choice = expert_out[expert_flat, pos_c]  # (T*K, D)
+    weights = (gate_vals.reshape(t * k) * keep).astype(ht.dtype)
+    out = (out_choice * weights[:, None]).reshape(t, k, d).sum(axis=1)
+
+    # Load-balancing aux loss (GShard/Mixtral form): E · Σ_e f_e · p̄_e with
+    # f_e counting ALL k routed choices — load arriving via second choices
+    # must be visible to the balancing pressure, since dispatch routes it.
+    frac = jnp.mean(
+        jax.nn.one_hot(gate_idx, e, dtype=jnp.float32).sum(axis=1), axis=0
+    ) / k
+    mean_prob = probs.mean(axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return out.reshape(b, s, d), aux
+
+
+def forward(
+    params,
+    tokens,
+    cfg: MoEConfig,
+    *,
+    mesh=None,
+    seq_axis: Optional[str] = None,
+    attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
+    return_aux: bool = False,
+):
+    """Token ids → logits; MoE FFN per block.  ``pp_axis`` unsupported for
+    MoE in this version (aux-loss accumulation crosses stages)."""
+    if pp_axis is not None:
+        raise NotImplementedError("pipeline + MoE not supported yet")
+    b, s = tokens.shape
+    x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    positions = jnp.arange(s)[None]
+
+    def block(carry, lp):
+        x, aux_sum = carry
+        h = llama_mod._rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = llama_mod._rope(q, positions, cfg.rope_theta)
+        k = llama_mod._rope(k, positions, cfg.rope_theta)
+        attn = attention(
+            q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
+        )
+        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        h = llama_mod._rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        ffn, aux = moe_ffn(
+            h, lp["router"], lp["e_gate"], lp["e_up"], lp["e_down"], cfg
+        )
+        return (x + ffn, aux_sum + aux), None
+
+    body = jax.checkpoint(block) if cfg.remat else block
+    (x, aux_sum), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
+    x = llama_mod._rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
+        jnp.float32
+    )
+    if return_aux:
+        return logits, aux_sum / cfg.n_layers
+    return logits
+
+
+def loss_fn(
+    params,
+    tokens,
+    targets,
+    cfg: MoEConfig,
+    *,
+    mesh=None,
+    seq_axis: Optional[str] = None,
+    attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
+):
+    """Cross-entropy + router load-balancing aux loss."""
+    logits, aux = forward(
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
+        attn_impl=attn_impl, pp_axis=pp_axis, return_aux=True,
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean() + cfg.router_aux_coef * aux

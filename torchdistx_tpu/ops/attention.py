@@ -65,6 +65,36 @@ def mha_reference(q, k, v, *, causal: bool = True, segment_ids=None):
     return out.reshape(b, sq, hq, d)
 
 
+def cached_attention(q, k_cache, v_cache, pos):
+    """Decode-time attention against a static-shape KV cache.
+
+    q ``(B, T, Hq, D)`` holds queries for positions ``pos .. pos+T-1``;
+    k/v caches ``(B, Smax, Hkv, D)`` are valid up to ``pos+T``.  Key ``j``
+    attends to query ``i`` iff ``j <= pos + i`` (global causal mask over the
+    cache; invalid tail masked out).  Static shapes → one compiled decode
+    step regardless of position.
+    """
+    import jax.numpy as jnp
+
+    b, t, hq, d = q.shape
+    smax, hkv = k_cache.shape[1], k_cache.shape[2]
+    groups = hq // hkv
+    qg = q.reshape(b, t, hkv, groups, d)
+    scale = 1.0 / (d**0.5)
+    logits = (
+        jnp.einsum("bqhgd,bkhd->bqhgk", qg, k_cache).astype(jnp.float32)
+        * scale
+    )
+    valid = jnp.arange(smax)[None, :] <= (pos + jnp.arange(t))[:, None]
+    logits = jnp.where(
+        valid[None, :, None, None, :], logits, _neg_inf(jnp.float32)
+    )
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bqhgk,bkhd->bqhgd", probs.astype(v_cache.dtype), v_cache)
+    return out.reshape(b, t, hq, d)
+
+
 @functools.lru_cache(maxsize=1)
 def _on_tpu() -> bool:
     import jax

@@ -29,6 +29,7 @@ class MeshSpec:
     """Named mesh shape, e.g. ``MeshSpec(dp=2, fsdp=2, tp=2)``."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
@@ -39,6 +40,7 @@ class MeshSpec:
             (name, size)
             for name, size in (
                 ("dp", self.dp),
+                ("pp", self.pp),
                 ("fsdp", self.fsdp),
                 ("tp", self.tp),
                 ("sp", self.sp),

@@ -1,0 +1,143 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pp`` mesh axis.
+
+The stacked-layer representation the model families already use
+(``(n_layers, ...)`` leaves scanned by ``lax.scan``) extends naturally to
+pipeline parallelism: shard the layer dim over ``pp`` so each device holds a
+contiguous *stage* of ``n_layers / pp`` blocks, split the batch into
+microbatches, and run the classic GPipe schedule — at tick ``t`` stage ``p``
+processes microbatch ``t - p``, handing activations to stage ``p+1`` with a
+single neighbor ``ppermute`` hop (ICI).  ``M + P - 1`` ticks drain the
+pipeline; bubble fraction ``(P-1)/(M+P-1)`` shrinks with more microbatches.
+
+Implemented as ``shard_map`` + ``lax.scan`` over ticks: nests inside the
+jitted train step, composes with dp/fsdp/tp on the other mesh axes, and is
+reverse-differentiable (scan + ppermute transpose), so pipeline-parallel
+*training* works through plain ``jax.grad``.
+
+The reference framework has no pipeline parallelism (SURVEY.md §2.3) — this
+is native new capability, like ring attention.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_forward", "stage_specs"]
+
+
+def _shard_map(fn, mesh, in_specs, out_specs, manual_axes):
+    try:
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names=frozenset(manual_axes), check_vma=False,
+        )
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            auto=frozenset(mesh.axis_names) - frozenset(manual_axes),
+            check_rep=False,
+        )
+
+
+def stage_specs(layer_specs, *, pp: str = "pp"):
+    """Prefix every stacked-layer spec with the ``pp`` axis on the layer dim
+    (composes with tp/fsdp on the trailing dims)."""
+    return jax.tree.map(
+        lambda s: P(pp, *s), layer_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
+def pipeline_forward(
+    x,
+    layer_params,
+    block_fn: Callable,
+    *,
+    mesh,
+    axis: str = "pp",
+    n_microbatches: int,
+):
+    """Run stacked layers over ``x (B, ...)`` with a GPipe schedule.
+
+    ``layer_params``: pytree with leading layer dim on every leaf, sharded
+    ``P(axis, ...)`` (see :func:`stage_specs`).  ``block_fn(x, lp) -> x``
+    is one transformer block given one layer's (unstacked) params.
+    ``n_microbatches`` must divide the global batch ``B``.
+
+    Only the ``axis`` dimension is manual inside the ``shard_map`` — every
+    other mesh axis (dp/fsdp/tp) stays *automatic*, so activations keep
+    their batch sharding and stage weights keep their fsdp/tp sharding with
+    XLA inserting the usual Megatron/ZeRO collectives inside each stage (no
+    all-gather of stage weights, no duplicated matmuls).
+    """
+    names = set(mesh.axis_names)
+    if axis not in names:
+        raise ValueError(f"mesh has no axis {axis!r} (axes: {mesh.axis_names})")
+    n_stages = mesh.shape[axis]
+    if x.shape[0] % n_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {n_microbatches} microbatches"
+        )
+    x_spec = P(*([None] * x.ndim))
+    param_specs_local = jax.tree.map(
+        lambda l: P(axis, *([None] * (l.ndim - 1))), layer_params
+    )
+
+    def body(x_local, params_local):
+        # x_local: (B_local, ...); params_local: (L/P, ...) for my stage.
+        p = jax.lax.axis_index(axis)
+        bt = x_local.shape[0] // n_microbatches
+        micro = x_local.reshape((n_microbatches, bt) + x_local.shape[1:])
+
+        def run_stage(act):
+            def scan_block(h, lp):
+                return block_fn(h, lp), None
+
+            out, _ = jax.lax.scan(scan_block, act, params_local)
+            return out
+
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        n_ticks = n_microbatches + n_stages - 1
+        out0 = jnp.zeros_like(micro)
+        carry0 = jnp.zeros_like(micro[0])
+
+        def tick(carry, t):
+            incoming, outputs = carry
+            m = t - p  # microbatch this stage works on at tick t
+            valid = (m >= 0) & (m < n_microbatches)
+            m_idx = jnp.clip(m, 0, n_microbatches - 1)
+            stage_in = jnp.where(
+                p == 0, jax.lax.dynamic_index_in_dim(micro, m_idx, 0,
+                                                     keepdims=False),
+                incoming,
+            )
+            y = run_stage(stage_in)
+            # Last stage banks its (valid) result.
+            bank = jnp.where(valid & (p == n_stages - 1), y, 0.0)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                outputs[m_idx] + bank.astype(outputs.dtype),
+                m_idx,
+                0,
+            )
+            # Hand activations to the next stage.
+            incoming = jax.lax.ppermute(y, axis, perm)
+            return (incoming, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (carry0, out0), jnp.arange(n_ticks)
+        )
+        # Only the last stage holds real outputs; make them visible on all
+        # stages (they're zeros elsewhere, so a psum is a broadcast).
+        outputs = jax.lax.psum(outputs, axis)
+        return outputs.reshape(x_local.shape)
+
+    return _shard_map(
+        body, mesh, in_specs=(x_spec, param_specs_local), out_specs=x_spec,
+        manual_axes={axis},
+    )(x, layer_params)

@@ -109,6 +109,8 @@ def make_train_step(
     tp: Optional[str] = "tp",
     fsdp: Optional[str] = "fsdp",
     seq_axis: Optional[str] = None,
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
     attn_impl: str = "auto",
     loss_fn: Optional[Callable] = None,
 ) -> Tuple[Callable, Callable]:
@@ -128,12 +130,21 @@ def make_train_step(
     step; ``batch`` is ``{"tokens": (B,S), "targets": (B,S)}`` sharded with
     :func:`batch_sharding`.  State buffers are donated.
     """
-    specs = model.param_specs(cfg, tp=tp, fsdp=fsdp)
+    # pp kwargs are only passed when pipeline parallelism is requested, so
+    # custom model families implementing the base protocol
+    # (param_specs(cfg, *, tp, fsdp); loss_fn without pp kwargs) still work.
+    pp_spec_kw = {"pp": pp_axis} if pp_axis is not None else {}
+    pp_loss_kw = (
+        {"pp_axis": pp_axis, "n_microbatches": n_microbatches}
+        if pp_axis is not None
+        else {}
+    )
+    specs = model.param_specs(cfg, tp=tp, fsdp=fsdp, **pp_spec_kw)
     abstract = model.abstract_params(cfg)
     param_shardings = fit_shardings(specs, abstract, mesh)
     _loss = loss_fn or functools.partial(
         model.loss_fn, cfg=cfg, mesh=mesh, seq_axis=seq_axis,
-        attn_impl=attn_impl,
+        attn_impl=attn_impl, **pp_loss_kw,
     )
 
     opt_abstract = jax.eval_shape(tx.init, abstract)
