@@ -4,6 +4,7 @@ The Python implementation is the executable spec; the native core
 (src/cc/tdx_core) must produce the same materialization call stacks.
 """
 
+import os
 import subprocess
 import sys
 
@@ -18,13 +19,84 @@ from torchdistx_tpu.deferred_init import (
     _get_record,
 )
 
+_FORCED_OFF = bool(os.environ.get("TDX_DISABLE_NATIVE"))
 
+
+@pytest.mark.skipif(_FORCED_OFF, reason="native explicitly disabled via env")
 def test_native_builds_and_loads():
     assert _native.native_available(), (
         "native core should build on demand (g++ is in this image)"
     )
 
 
+@pytest.mark.skipif(_FORCED_OFF, reason="native explicitly disabled via env")
+def test_stack_ops_available():
+    assert _native.stack_ops() is not None, (
+        "_tdx_stack extension should build on demand"
+    )
+
+
+def test_stack_leaves_matches_pytree():
+    import torch.utils._pytree as pytree
+
+    s = _native.stack_ops()
+    if s is None:
+        pytest.skip("native stack unavailable")
+    t = torch.ones(2)
+    cases = [
+        (1, 2, 3),
+        (t, [1, t], {"a": t, "b": (None, 2.0)}),
+        {"x": [t, {"y": (t,)}]},
+        t,
+        [],
+        ((), [], {}),
+    ]
+    for obj in cases:
+        assert s.leaves(obj) == pytree.tree_leaves(obj), obj
+
+
+def test_stack_convert_matches_pytree_map():
+    import torch.utils._pytree as pytree
+
+    s = _native.stack_ops()
+    if s is None:
+        pytest.skip("native stack unavailable")
+    t = torch.ones(2)
+    fn = lambda x: x * 2  # noqa: E731
+    obj = (t, [1, t], {"a": t, "b": (None, 2.0)}, "str")
+    got = s.convert(obj, fn)
+    want = pytree.tree_map(
+        lambda a: fn(a) if isinstance(a, torch.Tensor) else a, obj
+    )
+    assert pytree.tree_structure(got) == pytree.tree_structure(want)
+    for g, w in zip(pytree.tree_leaves(got), pytree.tree_leaves(want)):
+        if isinstance(g, torch.Tensor):
+            assert torch.equal(g, w)
+        else:
+            assert g == w
+    # Copy-on-write: no tensor change -> same object back.
+    scalars = (1, [2, 3], {"k": "v"})
+    assert s.convert(scalars, fn) is scalars
+
+
+def test_stack_convert_fallback_signals():
+    import collections
+
+    s = _native.stack_ops()
+    if s is None:
+        pytest.skip("native stack unavailable")
+    Point = collections.namedtuple("Point", "x y")
+    with pytest.raises(s.Fallback):
+        s.convert((Point(1, 2),), lambda x: x)
+    # strict mode rejects leaves outside the immutable domain
+    with pytest.raises(s.Fallback):
+        s.convert((object(),), lambda x: x, True)
+    # ...but accepts the torch value types
+    ok = (torch.float32, torch.device("cpu"), 1, 2.0, None, "s")
+    assert s.convert(ok, lambda x: x, True) is ok
+
+
+@pytest.mark.skipif(_FORCED_OFF, reason="native explicitly disabled via env")
 def test_low_level_graph_roundtrip():
     class Node:  # weak-referenceable registry payload
         def __init__(self, nr):
@@ -70,6 +142,7 @@ def _schedules(module):
     return out
 
 
+@pytest.mark.skipif(_FORCED_OFF, reason="native explicitly disabled via env")
 def test_schedules_match_python_fallback():
     m_native = deferred_init(Net)
     native_used = any(

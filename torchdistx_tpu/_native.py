@@ -117,6 +117,97 @@ def native_available() -> bool:
     return _load() is not None
 
 
+# ---------------------------------------------------------------------------
+# Native stack utilities (_tdx_stack extension module — the stack_utils.cc
+# analog; see src/cc/tdx_core/stack.cc)
+
+_STACK_SRC = os.path.join(_REPO_ROOT, "src", "cc", "tdx_core", "stack.cc")
+_STACK_LIB = os.path.join(_PKG_DIR, "lib", "_tdx_stack.so")
+
+_stack_lock = threading.Lock()
+_stack_mod = None
+_stack_failed = False
+
+
+def _try_build_stack() -> bool:
+    import sysconfig
+
+    if not os.path.exists(_STACK_SRC):
+        return False
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{_STACK_LIB}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(os.path.dirname(_STACK_LIB), exist_ok=True)
+        subprocess.run(
+            [
+                "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
+                f"-I{include}", "-o", tmp, _STACK_SRC,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _STACK_LIB)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _stale_stack() -> bool:
+    try:
+        return os.path.getmtime(_STACK_SRC) > os.path.getmtime(_STACK_LIB)
+    except OSError:
+        return True
+
+
+def stack_ops():
+    """The native stack-utils module, or None (pytree fallback).
+
+    On first use, registers ``torch.Tensor`` plus the immutable leaf domain
+    (the validation analog of deferred_init.cc:227-253) with the extension.
+    """
+    global _stack_mod, _stack_failed
+    if _stack_mod is not None or _stack_failed:
+        return _stack_mod
+    with _stack_lock:
+        if _stack_mod is not None or _stack_failed:
+            return _stack_mod
+        if os.environ.get("TDX_DISABLE_NATIVE"):
+            _stack_failed = True
+            return None
+        if (not os.path.exists(_STACK_LIB) or _stale_stack()) \
+                and not _try_build_stack():
+            if not os.path.exists(_STACK_LIB):
+                _stack_failed = True
+                return None
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "_tdx_stack", _STACK_LIB
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception:
+            _stack_failed = True
+            return None
+        import torch
+
+        mod.register_types(
+            torch.Tensor,
+            (
+                torch.dtype, torch.device, torch.layout,
+                torch.memory_format, torch.Generator,
+            ),
+        )
+        _stack_mod = mod
+        return _stack_mod
+
+
 class NativeGraph:
     """Owning handle over a tdx_graph, plus the op_nr → OpNode registry the
     Python side needs to map native schedules back to payloads.

@@ -347,7 +347,25 @@ def record_op(
                 f"{type(a).__name__} is not preservable."
             ) from e
 
-    p_args, p_kwargs = pytree.tree_map(preserve, (tuple(args), dict(kwargs)))
+    # Native fast path: C container recursion with `preserve` applied only
+    # to tensor leaves, validating everything else against the immutable
+    # domain (deferred_init.cc:227-253); full-domain pytree walk (which also
+    # deep-copies unknown preservable leaves) when validation signals out.
+    from .fake import _convert_tensors, _StrictFallback
+
+    try:
+        p_args, p_kwargs = _convert_tensors(
+            (tuple(args), dict(kwargs)), preserve, strict=True
+        )
+    except _StrictFallback:
+        # The aborted native walk already ran `preserve` on earlier tensor
+        # leaves; drop those side effects before the full retry or every
+        # external guard / dependency edge would be recorded twice.
+        guards.clear()
+        dep_nodes.clear()
+        p_args, p_kwargs = pytree.tree_map(
+            preserve, (tuple(args), dict(kwargs))
+        )
 
     op = Op(
         name=str(func),

@@ -39,6 +39,7 @@ from ._tape import OpNode, Tape, TensorRecord  # noqa: F401 (public graph types)
 from .fake import (
     FakeTensor,
     _fake_handler,
+    _flat_leaves,
     _ensure_tpu_device_registered,
     _suppress_cuda_lazy_init,
 )
@@ -106,8 +107,8 @@ class _DeferredInitMode(TorchDispatchMode):
             func, args, kwargs, default_device=self.default_device
         )
 
-        flat_in = pytree.arg_tree_leaves(*args, **kwargs)
-        flat_out = pytree.tree_leaves(out)
+        flat_in = _flat_leaves((args, kwargs))
+        flat_out = _flat_leaves(out)
         fake_outputs = [o for o in flat_out if isinstance(o, FakeTensor)]
         has_fake_arg = any(isinstance(a, FakeTensor) for a in flat_in)
         if has_fake_arg or fake_outputs:

@@ -44,6 +44,8 @@ import torch.utils._pytree as pytree
 from torch.utils._mode_utils import no_dispatch
 from torch.utils._python_dispatch import TorchDispatchMode
 
+from . import _native
+
 __all__ = [
     "FakeTensor",
     "fake_mode",
@@ -187,6 +189,46 @@ class _FakeMode(TorchDispatchMode):
         )
 
 
+def _flat_leaves(obj):
+    """Flatten containers to leaves — native stack walk when available.
+
+    The per-op hot path (this module + the deferred-init recorder) runs
+    three tree traversals per dispatched op; the native module
+    (src/cc/tdx_core/stack.cc, the stack_utils.cc analog) does the container
+    recursion in C.
+    """
+    s = _native.stack_ops()
+    if s is not None:
+        return s.leaves(obj)
+    return pytree.tree_leaves(obj)
+
+
+def _convert_tensors(obj, fn, *, strict: bool = False):
+    """Map ``fn`` over every tensor leaf of ``obj`` (copy-on-write).
+
+    Non-tensor leaves pass through untouched; with ``strict`` the native
+    walker additionally validates leaves against the immutable domain and
+    signals fallback for anything else.  Falls back to ``pytree.tree_map``
+    (applying ``fn`` to tensor leaves only) for exotic containers.
+    """
+    s = _native.stack_ops()
+    if s is not None:
+        try:
+            return s.convert(obj, fn, strict)
+        except s.Fallback:
+            pass
+    if strict:
+        raise _StrictFallback
+    return pytree.tree_map(
+        lambda a: fn(a) if isinstance(a, torch.Tensor) else a, obj
+    )
+
+
+class _StrictFallback(Exception):
+    """Raised when a strict convert must be retried by the caller's own
+    full-domain path (the recorder's deep-copy validation)."""
+
+
 def _tensor_to_meta(t: torch.Tensor) -> torch.Tensor:
     # Real (non-fake) tensor mixed into a faked op: use its metadata only.
     with no_dispatch():
@@ -203,7 +245,7 @@ def _fake_handler(func, args, kwargs, *, default_device: Optional[torch.device])
     claimed device (for factories), else the op runs for real untouched
     (fake.cc:534-536).
     """
-    flat_args = pytree.arg_tree_leaves(*args, **kwargs)
+    flat_args = _flat_leaves((args, kwargs))
     fakes = [a for a in flat_args if isinstance(a, FakeTensor)]
     has_tensor_args = any(isinstance(a, torch.Tensor) for a in flat_args)
 
@@ -251,9 +293,12 @@ def _fake_handler(func, args, kwargs, *, default_device: Optional[torch.device])
             return _tensor_to_meta(a)
         return a
 
-    u_args, u_kwargs = pytree.tree_map(unwrap, (tuple(args), dict(kwargs)))
+    u_args, u_kwargs = _convert_tensors((tuple(args), dict(kwargs)), unwrap)
     if u_kwargs.get("device") is not None:
         # Redispatch the factory to the meta backend (fake.cc:466-489).
+        # Copy first: the copy-on-write convert may have returned the input
+        # dict itself when no tensor leaf changed.
+        u_kwargs = dict(u_kwargs)
         u_kwargs["device"] = torch.device("meta")
 
     try:
@@ -273,7 +318,7 @@ def _fake_handler(func, args, kwargs, *, default_device: Optional[torch.device])
             return FakeTensor(o, out_device)
         return o
 
-    return pytree.tree_map(wrap, out)
+    return _convert_tensors(out, wrap)
 
 
 @contextlib.contextmanager

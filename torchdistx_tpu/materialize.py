@@ -116,14 +116,36 @@ class _MetaWindow:
 
 
 class _FunctionalReplay:
-    """Replays tape nodes as pure JAX computation over storage buffers."""
+    """Replays tape nodes as pure JAX computation over storage buffers.
 
-    def __init__(self, base_key, *, check_guards: bool = True):
+    ``key_lookup``/``ext_lookup`` parametrize the replay for template reuse
+    (the grouped strategy): per-node PRNG keys and external tensor values come
+    in as traced arguments instead of being baked into the trace, so one
+    compiled program serves every structurally identical call stack.
+    """
+
+    def __init__(
+        self,
+        base_key,
+        *,
+        check_guards: bool = True,
+        key_lookup=None,
+        ext_lookup=None,
+    ):
         self.base_key = base_key
         self.check_guards = check_guards
+        self.key_lookup = key_lookup
+        self.ext_lookup = ext_lookup
         # storage key -> (flat jnp value, element count)
         self.storages: Dict[int, Any] = {}
         self.replayed: set = set()
+
+    def key_for(self, node: OpNode):
+        import jax
+
+        if self.key_lookup is not None:
+            return self.key_lookup(node)
+        return jax.random.fold_in(self.base_key, node.op_nr)
 
     # -- engine plumbing ----------------------------------------------------
 
@@ -183,6 +205,8 @@ class _FunctionalReplay:
                 meta = a.node.out_metas[a.index]
                 return self.read(_MetaWindow(meta))
             if isinstance(a, torch.Tensor):
+                if self.ext_lookup is not None:
+                    return self.ext_lookup(a)
                 return jnp.asarray(a.detach().cpu().numpy())
             return a
 
@@ -227,9 +251,7 @@ class _LowerCtx:
 
     @property
     def key(self):
-        import jax
-
-        return jax.random.fold_in(self.engine.base_key, self.node.op_nr)
+        return self.engine.key_for(self.node)
 
     def out_meta(self, index: int) -> torch.Tensor:
         return self.node.out_metas[index]
@@ -247,6 +269,137 @@ def _strip_factory_kwargs(kwargs: dict) -> dict:
         if k not in ("device", "layout", "pin_memory", "memory_format",
                      "non_blocking", "generator")
     }
+
+
+# ---------------------------------------------------------------------------
+# Grouped (template) materialization: structural dedup of call stacks.
+#
+# Deep models repeat their init structure — 48 transformer blocks record 48
+# structurally identical call stacks per parameter kind, differing only in
+# PRNG stream (op_nr) and captured external tensors.  Compiling the union
+# program (the "fused" strategy) makes XLA chew through O(depth) copies of
+# the same subgraph; grouping instead compiles ONE small program per unique
+# stack *signature* (op sequence + shapes + scalar args) with per-node keys
+# and externals passed as traced arguments, then executes it per instance
+# (vmap-batched off-mesh).  Compile time becomes O(unique layer kinds), not
+# O(depth) — the TPU-idiomatic shape for init, and the reason the deferred
+# path beats eager init+transfer (BASELINE.md).
+
+
+def _analyze_stack(stack: List[OpNode], record) -> Optional[Tuple]:
+    """Signature + per-instance data for one call stack.
+
+    Returns ``(sig, ext_values, op_nrs)`` where ``sig`` is a hashable
+    structural signature — two stacks with equal signatures trace to
+    identical jaxprs when replayed with keys/externals as arguments — or
+    ``None`` if the stack is not groupable (unlowerable op present).
+    """
+    local = {n.op_nr: i for i, n in enumerate(stack)}
+    storage_ids: Dict[int, int] = {}
+
+    def sid(key: int) -> int:
+        return storage_ids.setdefault(key, len(storage_ids))
+
+    def win_sig(meta: Optional[torch.Tensor]):
+        if meta is None:
+            return None
+        w = _MetaWindow(meta)
+        return (
+            sid(w.storage_key),
+            w.shape,
+            w.strides,
+            w.offset,
+            str(w.dtype),
+            w.storage_elems,
+        )
+
+    ext_values: List[torch.Tensor] = []
+    node_sigs = []
+    for n in stack:
+        is_view = _is_view_node(n)
+        if not is_view and _packet_name(n.op.func) not in LOWERINGS:
+            return None
+
+        def norm(a):
+            if isinstance(a, OutputRef):
+                i = local.get(a.node.op_nr)
+                if i is None:
+                    # Dependency outside the stack — cannot template.
+                    raise _NotGroupable
+                return ("ref", i, a.index)
+            if isinstance(a, torch.Tensor):
+                if is_view:
+                    # View nodes are never resolved at replay; their args
+                    # must not consume external slots.
+                    return ("viewext", tuple(a.shape), str(a.dtype))
+                ext_values.append(a)
+                return ("ext", len(ext_values) - 1, tuple(a.shape), str(a.dtype))
+            if isinstance(
+                a,
+                (torch.dtype, torch.device, torch.layout, torch.memory_format),
+            ):
+                return ("t", str(a))
+            return ("v", a)
+
+        try:
+            leaves, treedef = pytree.tree_flatten((n.op.args, n.op.kwargs))
+            norm_leaves = tuple(norm(a) for a in leaves)
+        except _NotGroupable:
+            return None
+        except TypeError:
+            return None  # unhashable leaf somewhere; fused path handles it
+        node_sigs.append(
+            (
+                _packet_name(n.op.func),
+                repr(treedef),
+                norm_leaves,
+                tuple(win_sig(m) for m in n.out_metas),
+                tuple(n.mutated_args),
+                is_view,
+            )
+        )
+
+    sig = (
+        tuple(node_sigs),
+        local[record.node.op_nr],
+        record.index,
+    )
+    try:
+        hash(sig)
+    except TypeError:
+        return None
+    return sig, ext_values, [n.op_nr for n in stack]
+
+
+class _NotGroupable(Exception):
+    pass
+
+
+def _make_template(stack: List[OpNode], record, target_dtype):
+    """Build the replay template for one signature group.
+
+    Closes over the *representative* instance's nodes (shapes/ops identical
+    across the group by signature equality); per-node PRNG keys and external
+    tensor values come in as arguments, so the jitted template is reused by
+    every instance.
+    """
+    local = {n.op_nr: i for i, n in enumerate(stack)}
+
+    def template(keys, exts):
+        ext_iter = iter(exts)
+        eng = _FunctionalReplay(
+            None,
+            check_guards=False,
+            key_lookup=lambda node: keys[local[node.op_nr]],
+            ext_lookup=lambda t: next(ext_iter),
+        )
+        for n in stack:
+            eng.run_node(n)
+        return eng.value_of_output(record.node, record.index).astype(
+            target_dtype
+        )
+
+    return template
 
 
 # ---------------------------------------------------------------------------
@@ -337,6 +490,48 @@ def _check_guards_of(target: OpNode) -> None:
             guard.check()
 
 
+def _plan_groups(
+    jax_names: List[str],
+    fakes: Dict[str, FakeTensor],
+    stacks: Dict[str, List[OpNode]],
+    target_dtypes: Dict[str, Any],
+) -> Tuple[List[dict], List[str]]:
+    """Partition params into signature groups and fused leftovers.
+
+    A param is groupable iff its stack shares no node with any other param's
+    stack (per-target replay of a shared storage could otherwise advance it
+    past another target's read point) and every arg is hashable/templatable.
+    Returns ``(group_list, leftover_names)``; each group carries its
+    representative stack, per-instance external tensors, and op_nr rows.
+    """
+    owner_count: Dict[int, int] = {}
+    for name in jax_names:
+        for n in stacks[name]:
+            owner_count[n.op_nr] = owner_count.get(n.op_nr, 0) + 1
+
+    groups: Dict[tuple, dict] = {}
+    fused: List[str] = []
+    for name in jax_names:
+        stack = stacks[name]
+        if any(owner_count[n.op_nr] > 1 for n in stack):
+            fused.append(name)
+            continue
+        rec = _get_record(fakes[name])
+        analyzed = _analyze_stack(stack, rec)
+        if analyzed is None:
+            fused.append(name)
+            continue
+        sig, ext_values, op_nrs = analyzed
+        key = (sig, str(target_dtypes[name]))
+        g = groups.setdefault(
+            key, {"names": [], "exts": [], "nrs": [], "rep": (stack, rec)}
+        )
+        g["names"].append(name)
+        g["exts"].append(ext_values)
+        g["nrs"].append(op_nrs)
+    return list(groups.values()), fused
+
+
 def materialize_module_jax(
     module: nn.Module,
     *,
@@ -345,13 +540,13 @@ def materialize_module_jax(
     seed: int = 0,
     dtype: Optional[torch.dtype] = None,
     rng_impl: str = "threefry2x32",
+    strategy: str = "auto",
     _fallback_torch: bool = True,
 ) -> Dict[str, Any]:
     """Materialize every fake param/buffer of ``module`` as JAX arrays.
 
-    One ``jit``-compiled program computes the full parameter pytree with
-    per-leaf ``out_shardings`` from ``plan`` — XLA SPMD generates each shard
-    on its own device.  Returns ``{qualified_name: jax.Array}``.
+    Returns ``{qualified_name: jax.Array}`` with per-leaf shardings from
+    ``plan`` — XLA SPMD generates each shard on its own device.
 
     ``plan``: ``None`` (replicated), a dict ``{name: PartitionSpec}``, or a
     callable ``(name, shape) -> PartitionSpec | None`` (see
@@ -359,6 +554,16 @@ def materialize_module_jax(
     ``dtype``: optional cast applied to every leaf (e.g. ``torch.bfloat16``
     for TPU training).  ``rng_impl``: see :func:`materialize_tensor_jax`
     (``"rbg"`` roughly halves XLA compile time for init-heavy tapes).
+
+    ``strategy``:
+
+    * ``"grouped"``/``"auto"`` — dedupe structurally identical per-param call
+      stacks and compile one small program per unique signature (compile time
+      O(unique layer kinds), not O(depth)); params whose stacks share nodes
+      with other params fall back to the fused program, preserving
+      write-ordering semantics through aliases.
+    * ``"fused"`` — one monolithic jit of the union init subgraph (the
+      round-1 behavior).
     """
     import jax
 
@@ -370,14 +575,19 @@ def materialize_module_jax(
     for _, fake in named:
         _check_guards_of(_get_record(fake).node)
 
+    fakes = dict(named)
+    stacks: Dict[str, List[OpNode]] = {
+        name: _tape.build_call_stack(_get_record(fake).node)
+        for name, fake in named
+    }
+
     jax_names: List[str] = []
     unsupported: List[Tuple[str, FakeTensor]] = []
     # Probe lowerability cheaply: every non-view node in each call stack
     # must have a lowering.
     for name, fake in named:
-        node = _get_record(fake).node
         ok = True
-        for n in _tape.build_call_stack(node):
+        for n in stacks[name]:
             if _is_view_node(n):
                 continue
             if _packet_name(n.op.func) not in LOWERINGS:
@@ -385,33 +595,78 @@ def materialize_module_jax(
                 break
         (jax_names.append(name) if ok else unsupported.append((name, fake)))
 
-    fakes = dict(named)
     target_dtypes = {
         name: jnp_dtype_of(dtype or fakes[name].dtype) for name, _ in named
     }
 
-    def compute():
-        eng = _FunctionalReplay(_base_key(seed, rng_impl), check_guards=False)
-        # Union of all targets' call stacks, replayed once in global
-        # chronological order: a per-target replay could advance a shared
-        # storage past an earlier target's read point (write-after-read
-        # through an alias), making results depend on traversal order.
-        nodes: Dict[int, OpNode] = {}
-        for name in jax_names:
-            for n in _tape.build_call_stack(_get_record(fakes[name]).node):
-                nodes[n.op_nr] = n
-        for nr in sorted(nodes):
-            eng.run_node(nodes[nr])
-        out = {}
-        for name in jax_names:
-            rec = _get_record(fakes[name])
-            out[name] = eng.value_of_output(rec.node, rec.index).astype(
-                target_dtypes[name]
-            )
-        return out
-
     results: Dict[str, Any] = {}
+    if strategy in ("auto", "grouped"):
+        group_list, fused_names = _plan_groups(
+            jax_names, fakes, stacks, target_dtypes
+        )
+    elif strategy == "fused":
+        group_list, fused_names = [], list(jax_names)
+    else:
+        raise ValueError(f"unknown strategy: {strategy!r}")
+
     if jax_names:
+        import numpy as np
+
+        templates = [
+            _make_template(*g["rep"], target_dtypes[g["names"][0]])
+            for g in group_list
+        ]
+        # Per-group traced inputs: op_nr rows (n_inst, n_nodes) and external
+        # tensor slots stacked along the instance axis.
+        nrs_in = [np.asarray(g["nrs"], dtype=np.uint32) for g in group_list]
+        exts_in = [
+            [
+                np.stack(
+                    [
+                        g["exts"][i][j].detach().cpu().numpy()
+                        for i in range(len(g["names"]))
+                    ]
+                )
+                for j in range(len(g["exts"][0]))
+            ]
+            for g in group_list
+        ]
+
+        def compute(nrs_in, exts_in):
+            base_key = _base_key(seed, rng_impl)
+            fold = jax.vmap(
+                jax.vmap(lambda nr: jax.random.fold_in(base_key, nr))
+            )
+            out = {}
+            # Signature groups: one vmapped template each — the compiled
+            # program contains one subgraph per unique layer *kind*, not per
+            # layer (compile time O(unique kinds), not O(depth)).
+            for g, template, nrs, exts in zip(
+                group_list, templates, nrs_in, exts_in
+            ):
+                res = jax.vmap(template)(fold(nrs), exts)
+                for i, name in enumerate(g["names"]):
+                    out[name] = res[i]
+            # Fused leftovers: union of the remaining targets' call stacks,
+            # replayed once in global chronological order — a per-target
+            # replay could advance a shared storage past an earlier target's
+            # read point (write-after-read through an alias), making results
+            # depend on traversal order.
+            if fused_names:
+                eng = _FunctionalReplay(base_key, check_guards=False)
+                nodes: Dict[int, OpNode] = {}
+                for name in fused_names:
+                    for n in stacks[name]:
+                        nodes[n.op_nr] = n
+                for nr in sorted(nodes):
+                    eng.run_node(nodes[nr])
+                for name in fused_names:
+                    rec = _get_record(fakes[name])
+                    out[name] = eng.value_of_output(
+                        rec.node, rec.index
+                    ).astype(target_dtypes[name])
+            return out
+
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -421,9 +676,11 @@ def materialize_module_jax(
                 )
                 for name in jax_names
             }
-            results.update(jax.jit(compute, out_shardings=shardings)())
+            results.update(
+                jax.jit(compute, out_shardings=shardings)(nrs_in, exts_in)
+            )
         else:
-            results.update(jax.jit(compute)())
+            results.update(jax.jit(compute)(nrs_in, exts_in))
 
     # Torch fallback for ops with no lowering: replay on host, transfer with
     # the planned sharding.  Per-tensor, so peak host RAM ≈ largest param.

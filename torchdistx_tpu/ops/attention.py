@@ -118,13 +118,21 @@ def attention(
     """Dispatching attention entry point used by the model stack.
 
     ``impl``: ``"auto" | "jnp" | "pallas" | "ring"``.  ``auto`` = ring iff
-    ``seq_axis`` is set (sequence/context parallelism), else pallas on TPU,
-    else jnp.
+    ``seq_axis`` is set (sequence/context parallelism); else pallas on TPU
+    when ``mesh`` is None (single-chip); else jnp (XLA-fused, partitions
+    correctly under a mesh).
     """
     if impl == "auto":
         if seq_axis is not None:
             impl = "ring"
-        elif _on_tpu():
+        elif mesh is None and _on_tpu():
+            # Only auto-select the Pallas kernel outside a mesh: a Mosaic
+            # pallas_call carries no SPMD partitioning rules, so inside a
+            # sharded jit program it would fail to partition (or silently
+            # replicate full attention per chip).  Under a mesh, XLA's fused
+            # jnp path partitions correctly; pass impl="pallas" explicitly to
+            # opt in (e.g. single-axis data parallelism where heads/batch are
+            # replicated per chip).
             impl = "pallas"
         else:
             impl = "jnp"

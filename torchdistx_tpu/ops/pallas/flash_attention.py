@@ -1,20 +1,33 @@
-"""Fused flash attention — Pallas TPU kernel.
+"""Fused flash attention — Pallas TPU kernels (forward + backward).
 
-Single-chip attention for the model stack (:mod:`torchdistx_tpu.models`):
-Q is tiled into blocks that stream through VMEM while the full K/V rows for
-the (kv-)head sit in VMEM; logits/softmax run in float32 on the VPU and both
-matmuls hit the MXU via ``jnp.dot(..., preferred_element_type=f32)``.  GQA is
-handled in the index maps — each Q-head grid step fetches its kv-head's K/V
-block (no materialized head expansion, no extra HBM traffic).
+Single-chip attention for the model stack (:mod:`torchdistx_tpu.models`).
+Both Q **and** K/V are tiled: the kv dimension is a grid axis streamed
+through VMEM with online-softmax accumulators held in VMEM scratch, so
+per-step VMEM is O(bq·d + bkv·d) regardless of sequence length — the
+long-context regime (S ≥ 16k) the kernel exists for.  Logits/softmax run in
+float32 on the VPU; both matmuls hit the MXU via
+``preferred_element_type=f32``.  GQA is handled in the index maps — each
+Q-head grid step fetches its kv-head's K/V block (no materialized head
+expansion, no extra HBM traffic).
 
-The public entry is differentiable via ``jax.custom_vjp``: the forward runs
-the Pallas kernel (saving the f32 log-sum-exp), the backward uses the
-standard flash-attention gradient identities computed with XLA (dv = pᵀ·do,
-ds = p∘(do·vᵀ − rowsum(do∘o)), dq = ds·k, dk = dsᵀ·q) — exact, recompute-
-based, nothing saved but q/k/v/out/lse.
+The backward is two Pallas kernels using the standard flash-attention
+gradient identities (dv = pᵀ·do, ds = p∘(do·vᵀ − rowsum(do∘o)),
+dq = ds·k, dk = dsᵀ·q), each streaming its reduction axis through a grid
+dimension with VMEM scratch accumulators:
 
-``interpret=True`` runs the same kernel through the Pallas interpreter so CPU
-CI (the virtual-mesh test rig, SURVEY.md §4) covers the kernel logic.
+* dq kernel: grid ``(B, Hq, nq, nkv)`` — accumulates dq over kv blocks;
+* dk/dv kernel: grid ``(B, Hkv, nkv, groups·nq)`` — accumulates dk/dv over
+  (gqa-group, q-block) pairs, summing the GQA group reduction in-kernel.
+
+Sequence lengths are padded to the TPU tile grain (128, or 8 below one
+block); padded keys/queries are masked in-kernel, so any length is accepted.
+The log-sum-exp/delta tensors are carried as ``(B, H, S_pad, 1)`` so their
+``(1, 1, bq, 1)`` blocks satisfy Mosaic's (8, 128)-or-equal tiling rule on
+the last two block dims (the round-1 ``(1, 1, bq)`` spec did not compile on
+real TPU).
+
+``interpret=True`` runs the same kernels through the Pallas interpreter so
+CPU CI (the virtual-mesh test rig, SURVEY.md §4) covers the kernel logic.
 """
 
 from __future__ import annotations
@@ -27,154 +40,379 @@ import jax.numpy as jnp
 
 __all__ = ["flash_attention"]
 
-_NEG_INF = float("-inf")
+# Finite "minus infinity": keeps the online-softmax recurrences NaN-free for
+# rows whose valid keys haven't streamed in yet (exp(-1e30 − m) underflows to
+# exactly 0; -inf would produce inf−inf = NaN in the rescale term).
+_MASK = -1e30
 
 
-def _pick_block(s: int, preferred: int = 256) -> int:
-    if s <= preferred:
-        return s
-    b = preferred
-    while s % b:
-        b //= 2
-    return max(b, 1)
+def _pad_len(s: int) -> int:
+    """Sequence padded to the TPU tile grain."""
+    if s >= 128:
+        return -(-s // 128) * 128
+    return -(-s // 8) * 8
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq):
+def _block_for(s_pad: int, preferred: int = 256) -> int:
+    for b in (preferred, 128):
+        if s_pad % b == 0:
+            return b
+    return s_pad  # s_pad < 128: single block (equality escape in Mosaic)
+
+
+def _iota(shape, axis):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, axis)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale, causal, bq, bkv, s,
+):
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
-    k = k_ref[0, 0].astype(jnp.float32)  # (S, d)
-    v = v_ref[0, 0]  # (S, d)
-    s = k.shape[0]
-    logits = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, s), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (bq, s), 1)
-        logits = jnp.where(qpos >= kpos, logits, _NEG_INF)
-    m = jnp.max(logits, axis=-1, keepdims=True)
-    p = jnp.exp(logits - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        (p / l).astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[0, 0] = o.astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_start = qi * bq
+    k_start = ki * bkv
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _MASK)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: skip kv blocks entirely above the diagonal.
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bkv, d)
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        qpos = q_start + _iota((bq, bkv), 0)
+        kpos = k_start + _iota((bq, bkv), 1)
+        mask = (kpos < s) & (qpos < s)
+        if causal:
+            mask &= qpos >= kpos
+        logits = jnp.where(mask, logits, _MASK)
+
+        m_prev = m_ref[...]  # (bq, 128), lane-replicated row max
+        l_prev = l_ref[...]
+        row_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
+        m_next = jnp.maximum(m_prev, row_max)
+        alpha = jnp.exp(m_prev - m_next)  # (bq, 128)
+        p = jnp.exp(logits - m_next[:, :1])  # (bq, bkv)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_next
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[...][:, :1]  # (bq, 1)
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked (padded) rows
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[...][:, :1] + jnp.log(l_safe)
 
 
-def _fa_forward(q, k, v, *, causal: bool, interpret: bool):
-    """q: (B, Hq, S, D); k/v: (B, Hkv, S, D) → (out, lse)."""
+def _fa_forward_padded(q, k, v, s, *, causal: bool, interpret: bool):
+    """q: (B, Hq, S_pad, D); k/v: (B, Hkv, S_pad, D); ``s`` = valid length.
+
+    Returns ``(out, lse)`` with ``out`` matching q's shape and ``lse``
+    ``(B, Hq, S_pad)`` float32.
+    """
     import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
 
-    b, hq, s, d = q.shape
+    b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
     groups = hq // hkv
-    bq = _pick_block(s)
+    bq = _block_for(s_pad)
+    bkv = _block_for(s_pad)
+    nq, nk = s_pad // bq, s_pad // bkv
     scale = 1.0 / (d**0.5)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, hq, s // bq),
+        grid=(b, hq, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi // groups, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, bkv, d),
+                lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bkv, d),
+                lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0),
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, hq, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, s_pad, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
     return out, lse
 
 
-def _expand_kv(t, groups):
-    # (B, Hkv, S, D) -> (B, Hq, S, D) for the XLA backward.
-    return jnp.repeat(t, groups, axis=1) if groups > 1 else t
+# ---------------------------------------------------------------------------
+# Backward
 
 
-def _fa_backward_xla(q, k, v, out, lse, do, *, causal, scale):
-    """Exact flash-attention gradients, recomputed in XLA (f32).
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, scale, causal, bq, bkv, s,
+):
+    import jax.experimental.pallas as pl
 
-    Chunked over Q blocks with a ``lax.scan`` accumulating dk/dv, so peak
-    memory is O(bq·S) logits per head — the same order as the forward
-    kernel — never the full (S, S) attention matrix.
-    """
-    b, hq, s, d = q.shape
-    hkv = k.shape[1]
-    groups = hq // hkv
-    kx = _expand_kv(k, groups).astype(jnp.float32)
-    vx = _expand_kv(v, groups).astype(jnp.float32)
-    bq = _pick_block(s)
-    nblk = s // bq
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_start = qi * bq
+    k_start = ki * bkv
 
-    def chunk(t):  # (B, H, S, ...) -> (nblk, B, H, bq, ...)
-        return jnp.moveaxis(
-            t.reshape(t.shape[:2] + (nblk, bq) + t.shape[3:]), 2, 0
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # (bq, 1)
+        delta = delta_ref[0, 0]
+
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        qpos = q_start + _iota((bq, bkv), 0)
+        kpos = k_start + _iota((bq, bkv), 1)
+        mask = (kpos < s) & (qpos < s)
+        if causal:
+            mask &= qpos >= kpos
+        p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
-    q_c = chunk(q.astype(jnp.float32))
-    do_c = chunk(do.astype(jnp.float32))
-    delta_c = chunk(jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                            axis=-1, keepdims=True))
-    lse_c = chunk(lse[..., None])
-    kpos = jnp.arange(s)
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
-    def step(carry, blk):
-        dk_acc, dv_acc, i = carry
-        qi, doi, di, li = blk
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qi, kx) * scale
-        if causal:
-            qpos = i * bq + jnp.arange(bq)
-            logits = jnp.where(
-                (qpos[:, None] >= kpos[None, :])[None, None], logits, _NEG_INF
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, causal, bq, bkv, s, nq,
+):
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(2)
+    idx = pl.program_id(3)  # (gqa group, q block) pairs
+    n_idx = pl.num_programs(3)
+    qi = idx % nq
+    q_start = qi * bq
+    k_start = ki * bkv
+
+    @pl.when(idx == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        logits = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
-        p = jnp.exp(logits - li)  # rows sum to 1
-        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, doi)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", doi, vx)
-        ds = p * (dp - di) * scale
-        dqi = jnp.einsum("bhqk,bhkd->bhqd", ds, kx)
-        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, qi)
-        return (dk_acc, dv_acc, i + 1), dqi
+            * scale
+        )
+        qpos = q_start + _iota((bq, bkv), 0)
+        kpos = k_start + _iota((bq, bkv), 1)
+        mask = (kpos < s) & (qpos < s)
+        if causal:
+            mask &= qpos >= kpos
+        p = jnp.where(mask, jnp.exp(logits - lse), 0.0)  # (bq, bkv)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale  # (bq, bkv)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    zeros = jnp.zeros((b, hq, s, d), dtype=jnp.float32)
-    (dk, dv, _), dq_c = jax.lax.scan(
-        step, (zeros, zeros, jnp.zeros((), jnp.int32)),
-        (q_c, do_c, delta_c, lse_c),
+    @pl.when(idx == n_idx - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    b, hq, s_pad, d = q.shape
+    hkv = k.shape[1]
+    groups = hq // hkv
+    bq = _block_for(s_pad)
+    bkv = _block_for(s_pad)
+    nq, nk = s_pad // bq, s_pad // bkv
+    scale = 1.0 / (d**0.5)
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # (B, Hq, S_pad, 1)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, bkv, d), lambda bi, hi, qi, ki, g=groups: (bi, hi // g, ki, 0)
     )
-    dq = jnp.moveaxis(dq_c, 0, 2).reshape(b, hq, s, d)
-    if groups > 1:
-        dk = dk.reshape(b, hkv, groups, s, d).sum(axis=2)
-        dv = dv.reshape(b, hkv, groups, s, d).sum(axis=2)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    row_spec = pl.BlockSpec(
+        (1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s
+        ),
+        grid=(b, hq, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: grid over kv blocks with the (group, q-block) reduction as the
+    # innermost axis — the GQA head-group sum happens in the accumulator.
+    gq_q_spec = pl.BlockSpec(
+        (1, 1, bq, d),
+        lambda bi, hkvi, ki, idx, g=groups, n=nq: (
+            bi, hkvi * g + idx // n, idx % n, 0
+        ),
+    )
+    gq_row_spec = pl.BlockSpec(
+        (1, 1, bq, 1),
+        lambda bi, hkvi, ki, idx, g=groups, n=nq: (
+            bi, hkvi * g + idx // n, idx % n, 0
+        ),
+    )
+    kv_out_spec = pl.BlockSpec(
+        (1, 1, bkv, d), lambda bi, hkvi, ki, idx: (bi, hkvi, ki, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s,
+            nq=nq,
+        ),
+        grid=(b, hkv, nk, groups * nq),
+        in_specs=[
+            gq_q_spec, kv_out_spec, kv_out_spec, gq_q_spec,
+            gq_row_spec, gq_row_spec,
+        ],
+        out_specs=[kv_out_spec, kv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _fa(q, k, v, causal, interpret):
-    out, _ = _fa_forward(q, k, v, causal=causal, interpret=interpret)
+# ---------------------------------------------------------------------------
+# Differentiable entry (operates on padded (B, H, S_pad, D) layout)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fa(q, k, v, s, causal, interpret):
+    out, _ = _fa_forward_padded(q, k, v, s, causal=causal, interpret=interpret)
     return out
 
 
-def _fa_fwd(q, k, v, causal, interpret):
-    out, lse = _fa_forward(q, k, v, causal=causal, interpret=interpret)
+def _fa_fwd(q, k, v, s, causal, interpret):
+    out, lse = _fa_forward_padded(
+        q, k, v, s, causal=causal, interpret=interpret
+    )
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, interpret, res, do):
+def _fa_bwd(s, causal, interpret, res, do):
     q, k, v, out, lse = res
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    return _fa_backward_xla(q, k, v, out, lse, do, causal=causal, scale=scale)
+    return _fa_backward(
+        q, k, v, out, lse, do, s, causal=causal, interpret=interpret
+    )
 
 
 _fa.defvjp(_fa_fwd, _fa_bwd)
@@ -185,14 +423,23 @@ def flash_attention(
 ):
     """Fused attention.  Layout matches the model stack: ``(B, S, H, D)``.
 
-    ``interpret``: force the Pallas interpreter (None = auto: interpret on
-    non-TPU backends so the kernel is testable on the CPU mesh rig).
+    Any sequence length is accepted (padded to the TPU tile grain and masked
+    in-kernel).  ``interpret``: force the Pallas interpreter (None = auto:
+    interpret on non-TPU backends so the kernel is testable on the CPU mesh
+    rig).
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    b, s, hq, d = q.shape
+    s_pad = _pad_len(s)
     # Kernel layout is (B, H, S, D).
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _fa(qt, kt, vt, causal, interpret)
+    if s_pad != s:
+        pad = ((0, 0), (0, 0), (0, s_pad - s), (0, 0))
+        qt, kt, vt = (jnp.pad(t, pad) for t in (qt, kt, vt))
+    out = _fa(qt, kt, vt, s, causal, interpret)
+    if s_pad != s:
+        out = out[:, :, :s, :]
     return out.transpose(0, 2, 1, 3)

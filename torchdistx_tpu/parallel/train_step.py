@@ -239,8 +239,11 @@ def make_slowmo_train_step(
     )
 
     def _loss(params, tokens, targets):
+        # mesh is forwarded so attention()'s auto-dispatch knows it is inside
+        # a sharded program (a Mosaic pallas_call has no SPMD partitioning
+        # rules and must not be auto-selected under a mesh).
         return model.loss_fn(
-            params, tokens, targets, cfg, attn_impl=attn_impl
+            params, tokens, targets, cfg, mesh=mesh, attn_impl=attn_impl
         )
 
     @functools.partial(jax.jit, out_shardings=state_shardings)
