@@ -227,9 +227,14 @@ def main():
 
     jax.block_until_ready(jax.device_put(1.0))  # backend warm-up
 
+    from torchdistx_tpu.models.resnet_torch import resnet50
+
     xl = bench_materialize(GPT2XL, dtype=torch.bfloat16)
     small = bench_materialize(
         GPT2Small, dtype=torch.float32, report_rss=False
+    )
+    resnet = bench_materialize(
+        resnet50, dtype=torch.float32, report_rss=False
     )
     try:
         train = bench_train_step()
@@ -246,6 +251,7 @@ def main():
                 "details": {
                     "gpt2xl_1p6b_bf16": xl,
                     "gpt2small_124m_f32": small,
+                    "resnet50_25m_f32": resnet,
                     "train_step_llama_350m_pallas": train,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),

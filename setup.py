@@ -1,0 +1,48 @@
+"""Build the native core into the wheel.
+
+Parity with the reference's packaging story (setup.py +
+src/cc/torchdistx/CMakeLists.txt): ``pip install .`` / ``pip wheel .``
+produces a wheel whose ``torchdistx_tpu/lib`` contains the compiled native
+libraries, so installed environments never need the import-time g++
+fallback (which remains for editable/dev checkouts).
+
+Two artifacts (same compile lines as torchdistx_tpu/_native.py):
+
+* ``libtdx_core.so``  — plain C-ABI shared library (op-graph traversals)
+* ``_tdx_stack.so``   — CPython extension module (native stack utilities)
+"""
+
+import os
+import subprocess
+import sysconfig
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+CC_DIR = os.path.join(ROOT, "src", "cc", "tdx_core")
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        super().run()
+        lib_dir = os.path.join(self.build_lib, "torchdistx_tpu", "lib")
+        os.makedirs(lib_dir, exist_ok=True)
+        common = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared"]
+        subprocess.run(
+            common
+            + ["-o", os.path.join(lib_dir, "libtdx_core.so"),
+               os.path.join(CC_DIR, "graph.cc")],
+            check=True,
+        )
+        include = sysconfig.get_paths()["include"]
+        subprocess.run(
+            common
+            + [f"-I{include}",
+               "-o", os.path.join(lib_dir, "_tdx_stack.so"),
+               os.path.join(CC_DIR, "stack.cc")],
+            check=True,
+        )
+
+
+setup(cmdclass={"build_py": build_py_with_native})

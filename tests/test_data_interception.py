@@ -1,0 +1,156 @@
+"""`Tensor.data` interception under deferred init — the ProxyVariableHooks
+analog (reference: deferred_init.cc:888-1127).
+
+The reference records `variable_data()` / `set_data()` as synthetic ops
+because `nn.Parameter` / `Tensor.data` bypass the dispatcher.  Here the read
+path flows through the wrapper subclass (dispatched ops on the `.data` alias
+record normally); the setter is intercepted on FakeTensor (fake.py) because
+torch's `set_data` swaps the TensorImpl underneath the Python object, which
+would silently orphan the deferred-init record.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+import torchdistx_tpu.deferred_init as di
+
+try:
+    import jax  # noqa: F401
+
+    from torchdistx_tpu.materialize import materialize_module_jax
+
+    HAS_JAX = True
+except ImportError:
+    HAS_JAX = False
+
+needs_jax = pytest.mark.skipif(not HAS_JAX, reason="jax unavailable")
+
+
+class DataMutatingInit(nn.Module):
+    """The HF `_init_weights` pattern: in-place ops through `.data`."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+        self.lin.weight.data.fill_(3.0)
+        self.lin.bias.data.zero_()
+
+
+class DataAssignInit(nn.Module):
+    """`param.data = <fake tensor>` (set_data with a recorded RHS)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 4)
+        self.lin.weight.data = torch.full((4, 4), 7.0)
+
+
+def test_data_inplace_torch_replay():
+    m = di.deferred_init(DataMutatingInit)
+    di.materialize_module(m)
+    assert torch.equal(m.lin.weight.data, torch.full((4, 4), 3.0))
+    assert torch.equal(m.lin.bias.data, torch.zeros(4))
+
+
+@needs_jax
+def test_data_inplace_jax_replay():
+    m = di.deferred_init(DataMutatingInit)
+    out = materialize_module_jax(m)
+    np.testing.assert_allclose(np.asarray(out["lin.weight"]), 3.0)
+    np.testing.assert_allclose(np.asarray(out["lin.bias"]), 0.0)
+
+
+def test_set_data_fake_torch_replay():
+    m = di.deferred_init(DataAssignInit)
+    assert di.is_deferred(m.lin.weight)
+    di.materialize_module(m)
+    assert torch.equal(m.lin.weight.data, torch.full((4, 4), 7.0))
+
+
+@needs_jax
+def test_set_data_fake_jax_replay():
+    m = di.deferred_init(DataAssignInit)
+    out = materialize_module_jax(m)
+    np.testing.assert_allclose(np.asarray(out["lin.weight"]), 7.0)
+
+
+def test_set_data_external_real_tensor():
+    ext = torch.arange(9.0).reshape(3, 3)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(3, 3)
+            self.lin.weight.data = ext
+
+    m = di.deferred_init(M)
+    di.materialize_module(m)
+    assert torch.equal(m.lin.weight.data, ext)
+
+
+@needs_jax
+def test_set_data_external_real_tensor_jax():
+    ext = torch.arange(9.0).reshape(3, 3)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(3, 3)
+            self.lin.weight.data = ext
+
+    m = di.deferred_init(M)
+    out = materialize_module_jax(m)
+    np.testing.assert_allclose(
+        np.asarray(out["lin.weight"]), ext.numpy()
+    )
+
+
+def test_set_data_external_guard_fires():
+    ext = torch.ones(3, 3)
+
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(3, 3)
+            self.lin.weight.data = ext
+
+    m = di.deferred_init(M)
+    ext.add_(1)  # mutate after recording
+    with pytest.raises(RuntimeError, match="mutated after recording"):
+        di.materialize_module(m)
+
+
+def test_set_data_shape_change():
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(2, 2)
+            self.lin.weight.data = torch.zeros(5, 2)
+
+    m = di.deferred_init(M)
+    assert tuple(m.lin.weight.shape) == (5, 2)
+    di.materialize_module(m)
+    assert tuple(m.lin.weight.shape) == (5, 2)
+    assert torch.equal(m.lin.weight.data, torch.zeros(5, 2))
+
+
+def test_set_data_outside_context_real_raises():
+    m = di.deferred_init(nn.Linear, 4, 4)
+    with pytest.raises(RuntimeError, match="outside of a deferred-init"):
+        m.weight.data = torch.zeros(4, 4)
+
+
+def test_data_read_feeds_compute():
+    class M(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+            self.lin.weight.data.fill_(1.0)
+            # A read through .data feeding a new parameter.
+            self.scaled = nn.Parameter(self.lin.weight.data * 2)
+
+    m = di.deferred_init(M)
+    di.materialize_module(m)
+    assert torch.equal(m.scaled.data, torch.full((4, 4), 2.0))

@@ -1,0 +1,78 @@
+"""BASELINE config 2: deferred_init(resnet50) → materialize on one chip.
+
+Exercises the conv/BN init tape (kaiming conv, BN ones/zeros) end-to-end
+through both replay paths.  VERDICT r1 #5: must assert zero torch-fallback
+params on the JAX path.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import torchdistx_tpu.deferred_init as di
+from torchdistx_tpu.fake import FakeTensor
+from torchdistx_tpu.models.resnet_torch import resnet50
+
+try:
+    import jax  # noqa: F401
+
+    from torchdistx_tpu.materialize import materialize_module_jax
+
+    HAS_JAX = True
+except ImportError:
+    HAS_JAX = False
+
+needs_jax = pytest.mark.skipif(not HAS_JAX, reason="jax unavailable")
+
+
+@pytest.fixture(scope="module")
+def fake_resnet():
+    return di.deferred_init(resnet50)
+
+
+def test_resnet_constructs_fake(fake_resnet):
+    m = fake_resnet
+    n_params = sum(p.numel() for p in m.parameters())
+    assert 25e6 < n_params < 26e6  # ResNet-50 is ~25.6M params
+    assert all(isinstance(p, FakeTensor) for p in m.parameters())
+    # All float buffers are fake; num_batches_tracked stays real — the
+    # int64 scalar literal is allocated by python before dispatch can see
+    # it (tiny, and correct either way).
+    for name, b in m.named_buffers():
+        if "num_batches_tracked" in name:
+            assert not isinstance(b, FakeTensor)
+        else:
+            assert isinstance(b, FakeTensor), name
+
+
+@needs_jax
+def test_resnet_jax_materialize_no_fallback(fake_resnet):
+    # _fallback_torch=False: raises if ANY param would take the torch
+    # replay+transfer fallback — the zero-fallback assertion of VERDICT #5.
+    out = materialize_module_jax(fake_resnet, _fallback_torch=False)
+    fakes = sum(1 for _ in fake_resnet.parameters()) + sum(
+        1
+        for n, b in fake_resnet.named_buffers()
+        if "num_batches_tracked" not in n
+    )
+    assert len(out) == fakes
+    w = np.asarray(out["conv1.weight"])
+    assert w.shape == (64, 3, 7, 7)
+    # kaiming_uniform(a=sqrt5) on fan_in=3*7*7: bound = sqrt(6/((1+5)*147))
+    bound = (6.0 / (6 * 147)) ** 0.5
+    assert np.abs(w).max() <= bound + 1e-6
+    assert w.std() > 0.3 * bound
+    np.testing.assert_allclose(np.asarray(out["bn1.weight"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["bn1.running_var"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["bn1.running_mean"]), 0.0)
+    np.testing.assert_allclose(np.asarray(out["layer1.0.bn3.bias"]), 0.0)
+
+
+def test_resnet_torch_materialize_and_forward():
+    m = di.deferred_init(resnet50, num_classes=10)
+    di.materialize_module(m)
+    m.eval()
+    with torch.no_grad():
+        y = m(torch.randn(2, 3, 64, 64))
+    assert y.shape == (2, 10)
+    assert torch.isfinite(y).all()

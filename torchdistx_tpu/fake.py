@@ -152,6 +152,60 @@ class FakeTensor(torch.Tensor):
         r._slots = {}
         return r
 
+    # --- Tensor.data interception — the ProxyVariableHooks analog ---------
+    # The reference swaps autograd's global VariableHooksInterface for a
+    # recording proxy because `Tensor.data` reads/writes bypass the
+    # dispatcher (deferred_init.cc:888-1127).  Here the read path
+    # (`variable_data`) already flows through the wrapper subclass and the
+    # dispatched ops on the alias record normally; only the *setter*
+    # (`set_data`) needs interception: it swaps the TensorImpl underneath
+    # the Python object, which would orphan the fake's meta shadow and
+    # deferred-init record (the new tensor's record would be silently lost).
+
+    @property
+    def data(self):
+        return torch.Tensor.data.__get__(self)
+
+    @data.setter
+    def data(self, new):
+        if not isinstance(new, FakeTensor):
+            # A real tensor assigned into a fake param: lift it onto the
+            # tape as `aten.clone(new)` (external-guarded), the synthetic-op
+            # treatment of deferred_init.cc:905-947.
+            from . import _tape
+
+            tape = _tape.current_tape()
+            if tape is None:
+                raise RuntimeError(
+                    "Cannot assign a real tensor to `.data` of a fake "
+                    "tensor outside of a deferred-init context: the "
+                    "assignment could not be recorded for materialization."
+                )
+            with no_dispatch():
+                meta = torch.empty_strided(
+                    new.shape, new.stride(), dtype=new.dtype, device="meta"
+                )
+            lifted = FakeTensor(meta, self.fake_device)
+            from .deferred_init import _SLOT, _get_record as _gr  # noqa: F401
+
+            _tape.record_op(
+                tape,
+                torch.ops.aten.clone.default,
+                (new,),
+                {},
+                [lifted],
+                is_fake=lambda a: isinstance(a, FakeTensor),
+                get_record=lambda a: a._slots.get(_SLOT),
+                set_record=lambda a, r: a._slots.__setitem__(_SLOT, r),
+            )
+            new = lifted
+        # Swap the impl (shape/dtype may change — set_data semantics), then
+        # rebind the Python-side shadow state to the new tensor's.
+        torch.Tensor.data.__set__(self, new)
+        self._meta = new._meta
+        self._slots = dict(new._slots)
+        self.fake_device = new.fake_device
+
     # Like the reference's repr patch (fake.py:15-40) but scoped to the
     # subclass instead of monkey-patching torch.Tensor.__repr__ globally.
     def __repr__(self, *, tensor_contents=None):  # noqa: D105

@@ -63,6 +63,11 @@ def _dtype_or(kwargs, default):
 @lowering("aten.empty.memory_format", "aten.empty_strided.default",
           "aten.zeros.default", "aten.empty.default")
 def _zeros(ctx, size, *args, **kwargs):
+    # `empty` deliberately lowers to zeros: XLA has no uninitialized
+    # allocation, and deterministic zeros keep replay reproducible.  A
+    # recorded `empty` that a model READS without first writing would show
+    # torch-eager garbage but zeros here — a documented divergence (such a
+    # read is a bug in the model's init anyway).
     jnp = _jnp()
     dtype = _dtype_or(kwargs, jnp_dtype_of(ctx.out_meta(0).dtype))
     return jnp.zeros(tuple(size), dtype=dtype)

@@ -99,6 +99,13 @@ class SlowMomentumOptimizer:
         slowmo_factor: float = 0.5,
         slowmo_lr: float = 1.0,
     ):
+        # Averaging cadence: fires at steps slowmo_freq, 2·slowmo_freq, …
+        # The reference's PeriodicModelAverager (step counted from 0) also
+        # averages on the very first step, with the momentum update skipped
+        # there; steady-state behavior is identical, the phase differs by
+        # one deliberate step (a warmup average of identical replicas is a
+        # no-op in this functional formulation, where replicas start equal
+        # by construction).
         # Same ctor validation as the reference (slowmo_optimizer.py:96-115,
         # tested upstream at test_slowmo_fsdp.py:326-364).
         if slowmo_freq < 1:
@@ -210,6 +217,14 @@ def slowmo_state_dict(opt: SlowMomentumOptimizer, state: SlowMoState) -> dict:
 
 
 def load_slowmo_state_dict(opt: SlowMomentumOptimizer, d: dict) -> SlowMoState:
+    """Restore a SlowMo state dict.
+
+    .. warning:: Mutates ``opt``'s hyperparameters in place (the loaded
+       ``slowmo_freq/factor/lr/base_lr`` overwrite the constructor's) —
+       faithful to the reference's stateful ``load_state_dict`` contract
+       (slowmo_optimizer.py:156-189), and the one intentionally non-
+       functional seam in this API.
+    """
     # Validation parity with slowmo_optimizer.py:180-189 (missing learning
     # rate → ValueError, tested upstream test_slowmo_fsdp.py:318-324).
     for key in ("slowmo_freq", "slowmo_factor", "slowmo_lr", "base_lr"):
