@@ -79,6 +79,35 @@ def test_model_pipeline_forward_matches(model_mod, make_cfg):
     assert jnp.allclose(ref, out, atol=1e-4)
 
 
+def test_pipeline_skips_invalid_tick_compute():
+    """Ramp-up/drain ticks take a `lax.cond` identity branch — the compiled
+    module keeps a real HLO conditional (stage FLOPs are skipped at runtime,
+    not select-executed), in the forward and the transposed backward."""
+    mesh = make_mesh(axis_names=("dp", "pp"), shape=(2, 4))
+    L, B, D = 4, 4, 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.3
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+
+    def block(h, wl):
+        return jnp.tanh(h @ wl)
+
+    fwd = jax.jit(
+        lambda x, w: pipeline_forward(
+            x, w, block, mesh=mesh, axis="pp", n_microbatches=2
+        )
+    )
+    assert "conditional" in fwd.lower(x, w).compile().as_text()
+
+    bwd = jax.jit(jax.grad(
+        lambda w: (
+            pipeline_forward(
+                x, w, block, mesh=mesh, axis="pp", n_microbatches=2
+            ) ** 2
+        ).sum()
+    ))
+    assert "conditional" in bwd.lower(w).compile().as_text()
+
+
 def test_pipeline_train_step():
     import dataclasses
 

@@ -14,6 +14,25 @@ jitted train step, composes with dp/fsdp/tp on the other mesh axes, and is
 reverse-differentiable (scan + ppermute transpose), so pipeline-parallel
 *training* works through plain ``jax.grad``.
 
+Cost model (per device, ``P`` stages, ``M`` microbatches, ``T`` = one
+stage's per-microbatch compute):
+
+* **Ticks**: ``M + P - 1``; wall-clock ``(M + P - 1) · T`` against a
+  perfectly overlapped ideal of ``M · T`` → bubble overhead
+  ``(P - 1)/M``, amortized away by raising ``n_microbatches``.
+* **FLOPs**: stage compute is gated behind ``lax.cond`` on tick validity
+  (``0 ≤ t − p < M``), so ramp-up/drain ticks execute the identity branch —
+  each device performs exactly ``M`` stage-computations of real work, the
+  same FLOP count as an unpipelined run, in both the forward and the
+  ``cond``-transposed backward pass.  (An earlier revision ran every stage
+  on every tick: ``(P−1)/M`` pure waste.)
+* **Activation memory**: inputs are replicated over the ``pp`` axis (every
+  stage re-slices its current microbatch locally — no gather from stage 0),
+  which costs ``B·…`` per device *once*; they remain sharded as usual over
+  the automatic dp/fsdp axes, so the replication factor applies only to the
+  per-dp-shard slice.  Weights are never replicated: each stage holds its
+  ``L/P`` layers (sharded further by tp/fsdp on trailing dims).
+
 The reference framework has no pipeline parallelism (SURVEY.md §2.3) — this
 is native new capability, like ring attention.
 """
@@ -116,7 +135,16 @@ def pipeline_forward(
                                                      keepdims=False),
                 incoming,
             )
-            y = run_stage(stage_in)
+            # Gate the stage behind the validity predicate: ramp-up/drain
+            # ticks take the identity branch, skipping the stage's FLOPs in
+            # both the forward and (via cond's transpose) the backward pass.
+            # Deadlock-freedom invariant: the predicate varies only over the
+            # pp axis (it derives from this stage's axis_index and the tick),
+            # so every member of any tp/fsdp collective group XLA forms
+            # *inside* run_stage takes the same branch, and the pp-wide
+            # ppermute below runs unconditionally every tick.  A collective
+            # whose group spans pp must never move inside a branch.
+            y = jax.lax.cond(valid, run_stage, lambda act: act, stage_in)
             # Last stage banks its (valid) result.
             bank = jnp.where(valid & (p == n_stages - 1), y, 0.0)
             outputs = jax.lax.dynamic_update_index_in_dim(
