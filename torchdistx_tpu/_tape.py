@@ -44,12 +44,12 @@ _tls = threading.local()
 # caches / PRNG streams are keyed by op_nr.
 _op_counter = itertools.count()
 
-# Tape sequence numbers: the JAX materializer keys RNG as
-# fold_in(fold_in(seed, tape_seq), op_nr - tape_base) — *relative* op
-# numbers, so the same architecture recorded in any process produces the
-# same init program (HLO-stable → XLA persistent-cache hits), while the
-# tape_seq term keeps streams from colliding across tapes in one process.
-_tape_counter = itertools.count()
+# The JAX materializer derives RNG streams from (tape ordinal, *relative*
+# op number ``op_nr - node.base_nr``) — never from absolute op_nrs, which
+# depend on how many tapes ran earlier in the process.  Relative numbering
+# makes the same architecture materialize to the same values in any process
+# AND keeps the emitted HLO byte-stable (→ compilation-cache hits); see
+# materialize.py's RNG note.
 
 
 class OutputRef:
@@ -152,7 +152,6 @@ class OpNode:
         "num_outputs",
         "materialized_pyobjs",
         "native_graph",
-        "tape_seq",
         "base_nr",
         "__weakref__",
     )
@@ -194,8 +193,8 @@ class OpNode:
         # Shared strong handle: the graph must outlive every node that may
         # be materialized through it, long after the tape is popped.
         self.native_graph = None
-        # RNG stream identity (see _tape_counter note): set by record_op.
-        self.tape_seq = 0
+        # First op_nr of this node's tape — RNG streams key on the
+        # tape-relative number ``op_nr - base_nr`` (see module docstring).
         self.base_nr = 0
 
     def __repr__(self):
@@ -216,7 +215,6 @@ class Tape:
     def __init__(self):
         # storage key -> list of (op_nr, weakref to node) that WROTE it
         self.writers: Dict[int, List[Tuple[int, weakref.ref]]] = {}
-        self.seq = next(_tape_counter)
         self.base_nr: Optional[int] = None  # first recorded op_nr
         # Native-core mirror of the graph structure (C++ traversals for
         # call-stack building).  Per-tape: storage keys are raw addresses
@@ -376,6 +374,9 @@ def record_op(
         guards=guards,
     )
     node = OpNode(next(_op_counter), op)
+    if tape.base_nr is None:
+        tape.base_nr = node.op_nr
+    node.base_nr = tape.base_nr
     node.num_outputs = len(fake_outputs)
 
     # Output storages for aliasing checks (recordStorages,

@@ -28,10 +28,19 @@ Replay order is the same chronological call-stack the torch path uses
 (_tape.build_call_stack ≈ deferred_init.cc:529-621), so write-after-write and
 read-after-write through any alias resolve exactly as recorded.
 
-RNG: each recorded RNG op draws from ``jax.random.fold_in(key(seed), op_nr)``
-— deterministic, independent of materialization order, and identical across
-hosts, so multi-host sharded materialization is consistent by construction
-(the NCCL-broadcast-init analog: no broadcast needed at all).
+RNG: every node draws from
+``fold_in(fold_in(key(seed), tape_ordinal), tape_relative_op_nr)`` where
+``tape_ordinal`` numbers the distinct tapes reachable from the target(s) in
+first-appearance order and the relative op_nr is ``op_nr - base_nr`` (first
+op of the node's tape).  Properties: deterministic, independent of
+materialization order, reproducible across processes *and* across tapes in
+one process (absolute op counters never leak in), collision-free when
+separately recorded submodules are merged into one module (distinct
+ordinals), equal between :func:`materialize_tensor_jax` and
+:func:`materialize_module_jax` for the ordinary single-tape module, and
+identical across hosts — so multi-host sharded materialization is
+consistent by construction (the NCCL-broadcast-init analog: no broadcast
+needed at all).
 
 Ops with no JAX lowering fall back to torch replay + ``jax.device_put`` with
 the planned sharding (per-tensor, so host RAM stays bounded by the largest
@@ -51,6 +60,7 @@ from ._tape import OpNode, OutputRef
 from .deferred_init import _get_record, is_deferred
 from .fake import FakeTensor
 from .ops.aten_jax import LOWERINGS, UnsupportedOpError
+from .utils.compilation_cache import ensure_compilation_cache
 from .utils.dtypes import jnp_dtype_of
 
 __all__ = [
@@ -139,13 +149,29 @@ class _FunctionalReplay:
         # storage key -> (flat jnp value, element count)
         self.storages: Dict[int, Any] = {}
         self.replayed: set = set()
+        # Tape base_nr -> ordinal, assigned in replay (chronological) order;
+        # recording order is deterministic for a given program, so ordinals
+        # are process-stable.  See key_for.
+        self.tape_ordinals: Dict[int, int] = {}
 
     def key_for(self, node: OpNode):
         import jax
 
         if self.key_lookup is not None:
             return self.key_lookup(node)
-        return jax.random.fold_in(self.base_key, node.op_nr)
+        # Stream identity = (tape ordinal, tape-relative op_nr):
+        # reproducible across processes and across tapes in one process —
+        # absolute op_nrs depend on how many tapes preceded this one and
+        # never enter a key — and collision-free when a call stack spans
+        # several tapes (each gets a distinct ordinal).  Matches the module
+        # path for single-tape modules (module docstring, RNG note).
+        ordinal = self.tape_ordinals.setdefault(
+            node.base_nr, len(self.tape_ordinals)
+        )
+        return jax.random.fold_in(
+            jax.random.fold_in(self.base_key, ordinal),
+            node.op_nr - node.base_nr,
+        )
 
     # -- engine plumbing ----------------------------------------------------
 
@@ -289,7 +315,7 @@ def _strip_factory_kwargs(kwargs: dict) -> dict:
 def _analyze_stack(stack: List[OpNode], record) -> Optional[Tuple]:
     """Signature + per-instance data for one call stack.
 
-    Returns ``(sig, ext_values, op_nrs)`` where ``sig`` is a hashable
+    Returns ``(sig, ext_values)`` where ``sig`` is a hashable
     structural signature — two stacks with equal signatures trace to
     identical jaxprs when replayed with keys/externals as arguments — or
     ``None`` if the stack is not groupable (unlowerable op present).
@@ -368,7 +394,7 @@ def _analyze_stack(stack: List[OpNode], record) -> Optional[Tuple]:
         hash(sig)
     except TypeError:
         return None
-    return sig, ext_values, [n.op_nr for n in stack]
+    return sig, ext_values
 
 
 class _NotGroupable(Exception):
@@ -461,6 +487,8 @@ def materialize_tensor_jax(
     """
     import jax
 
+    ensure_compilation_cache()
+
     record = _get_record(tensor) if isinstance(tensor, FakeTensor) else None
     if record is None:
         raise ValueError("`tensor` is not a deferred fake tensor.")
@@ -521,15 +549,51 @@ def _plan_groups(
         if analyzed is None:
             fused.append(name)
             continue
-        sig, ext_values, op_nrs = analyzed
+        sig, ext_values = analyzed
         key = (sig, str(target_dtypes[name]))
         g = groups.setdefault(
-            key, {"names": [], "exts": [], "nrs": [], "rep": (stack, rec)}
+            key,
+            {"key": key, "names": [], "exts": [], "rep": (stack, rec)},
         )
         g["names"].append(name)
         g["exts"].append(ext_values)
-        g["nrs"].append(op_nrs)
     return list(groups.values()), fused
+
+
+# ---------------------------------------------------------------------------
+# In-process executable cache.
+#
+# The group signature IS the program identity: two materializations whose
+# groups carry equal signatures (and names/shardings/seed/rng) trace to the
+# same jaxpr, with all instance data — op_nr rows, external tensors —
+# entering as traced inputs.  Re-materializing the same architecture in one
+# process (hyperparameter sweeps, re-init after resharding, test suites)
+# therefore reuses the compiled executable outright: no retrace, no XLA
+# compile, no persistent-cache deserialization.  Cross-process warm starts
+# are covered separately by the persistent compilation cache
+# (utils/compilation_cache.py).
+
+_EXEC_CACHE: "Dict[tuple, Any]" = {}
+_EXEC_CACHE_MAX = 16
+exec_cache_hits = 0  # introspection for tests/benchmarks
+
+
+def _exec_cache_get(key):
+    global exec_cache_hits
+    fn = _EXEC_CACHE.get(key)
+    if fn is not None:
+        exec_cache_hits += 1
+    return fn
+
+
+def _exec_cache_put(key, fn) -> None:
+    import os
+
+    if os.environ.get("TDX_NO_EXEC_CACHE"):
+        return
+    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+        _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+    _EXEC_CACHE[key] = fn
 
 
 def materialize_module_jax(
@@ -564,8 +628,16 @@ def materialize_module_jax(
       write-ordering semantics through aliases.
     * ``"fused"`` — one monolithic jit of the union init subgraph (the
       round-1 behavior).
+
+    XLA compile time dominates a cold materialization; the emitted HLO is
+    process-stable by design, and the persistent compilation cache is
+    enabled on first use (see utils/compilation_cache.py), so warm runs —
+    restarts, sweeps, resharded re-inits of the same architecture — skip
+    compilation entirely.
     """
     import jax
+
+    ensure_compilation_cache()
 
     named = _named_fakes(module)
     if not named:
@@ -609,6 +681,14 @@ def materialize_module_jax(
     else:
         raise ValueError(f"unknown strategy: {strategy!r}")
 
+    # Tape ordinals: distinct tapes reachable from the targets, numbered in
+    # first-appearance order over the named params' stacks (deterministic
+    # across processes — iteration follows module naming order).
+    tape_ordinals: Dict[int, int] = {}
+    for name, _ in named:
+        for n in stacks[name]:
+            tape_ordinals.setdefault(n.base_nr, len(tape_ordinals))
+
     if jax_names:
         import numpy as np
 
@@ -616,9 +696,32 @@ def materialize_module_jax(
             _make_template(*g["rep"], target_dtypes[g["names"][0]])
             for g in group_list
         ]
-        # Per-group traced inputs: op_nr rows (n_inst, n_nodes) and external
-        # tensor slots stacked along the instance axis.
-        nrs_in = [np.asarray(g["nrs"], dtype=np.uint32) for g in group_list]
+        # Per-group traced inputs: per-instance per-node RNG identities —
+        # (tape ordinal, tape-relative op_nr) rows of shape (n_inst,
+        # n_nodes) — and external tensor slots stacked along the instance
+        # axis.  Instance data enters as *arguments*, so the traced program
+        # is byte-identical for any same-architecture materialization
+        # (exec-cache and persistent-cache hits).
+        ords_in = [
+            np.asarray(
+                [
+                    [tape_ordinals[n.base_nr] for n in stacks[name]]
+                    for name in g["names"]
+                ],
+                dtype=np.uint32,
+            )
+            for g in group_list
+        ]
+        rels_in = [
+            np.asarray(
+                [
+                    [n.op_nr - n.base_nr for n in stacks[name]]
+                    for name in g["names"]
+                ],
+                dtype=np.uint32,
+            )
+            for g in group_list
+        ]
         exts_in = [
             [
                 np.stack(
@@ -632,19 +735,23 @@ def materialize_module_jax(
             for g in group_list
         ]
 
-        def compute(nrs_in, exts_in):
+        def compute(ords_in, rels_in, exts_in):
             base_key = _base_key(seed, rng_impl)
             fold = jax.vmap(
-                jax.vmap(lambda nr: jax.random.fold_in(base_key, nr))
+                jax.vmap(
+                    lambda o, r: jax.random.fold_in(
+                        jax.random.fold_in(base_key, o), r
+                    )
+                )
             )
             out = {}
             # Signature groups: one vmapped template each — the compiled
             # program contains one subgraph per unique layer *kind*, not per
             # layer (compile time O(unique kinds), not O(depth)).
-            for g, template, nrs, exts in zip(
-                group_list, templates, nrs_in, exts_in
+            for g, template, ords, rels, exts in zip(
+                group_list, templates, ords_in, rels_in, exts_in
             ):
-                res = jax.vmap(template)(fold(nrs), exts)
+                res = jax.vmap(template)(fold(ords, rels), exts)
                 for i, name in enumerate(g["names"]):
                     out[name] = res[i]
             # Fused leftovers: union of the remaining targets' call stacks,
@@ -653,7 +760,19 @@ def materialize_module_jax(
             # read point (write-after-read through an alias), making results
             # depend on traversal order.
             if fused_names:
-                eng = _FunctionalReplay(base_key, check_guards=False)
+                eng = _FunctionalReplay(
+                    base_key,
+                    check_guards=False,
+                    key_lookup=lambda node: jax.random.fold_in(
+                        jax.random.fold_in(
+                            base_key,
+                            tape_ordinals.setdefault(
+                                node.base_nr, len(tape_ordinals)
+                            ),
+                        ),
+                        node.op_nr - node.base_nr,
+                    ),
+                )
                 nodes: Dict[int, OpNode] = {}
                 for name in fused_names:
                     for n in stacks[name]:
@@ -676,11 +795,54 @@ def materialize_module_jax(
                 )
                 for name in jax_names
             }
-            results.update(
-                jax.jit(compute, out_shardings=shardings)(nrs_in, exts_in)
-            )
         else:
-            results.update(jax.jit(compute)(nrs_in, exts_in))
+            shardings = None
+
+        # Executable-cache key: full program identity.  Only when every
+        # target is grouped — the fused path bakes instance data into the
+        # trace, so its programs are not reusable.
+        exec_key = None
+        if group_list and not fused_names and not unsupported:
+            try:
+                exec_key = (
+                    tuple(
+                        (g["key"], tuple(g["names"])) for g in group_list
+                    ),
+                    seed,
+                    rng_impl,
+                    None
+                    if mesh is None
+                    # str(NamedSharding) omits device identities — two
+                    # same-shape meshes over different devices must not
+                    # share executables, so key the device ids explicitly.
+                    else (
+                        tuple(d.id for d in mesh.devices.flat),
+                        tuple(
+                            (name, str(s))
+                            for name, s in sorted(shardings.items())
+                        ),
+                    ),
+                )
+                hash(exec_key)
+            except TypeError:
+                exec_key = None
+
+        jfn = _exec_cache_get(exec_key) if exec_key is not None else None
+        if jfn is None:
+            if shardings is not None:
+                jfn = jax.jit(compute, out_shardings=shardings)
+            else:
+                jfn = jax.jit(compute)
+            if exec_key is not None:
+                # Cache the AOT-compiled executable, not the jit wrapper:
+                # the wrapper would pin `compute`'s closure — the whole
+                # tape (OpNodes, deep-copied args, fakes) — for the cache
+                # entry's lifetime.  The compiled object holds only the
+                # executable; input shapes/dtypes are fixed by the group
+                # signatures in the key, so the AOT call always matches.
+                jfn = jfn.lower(ords_in, rels_in, exts_in).compile()
+                _exec_cache_put(exec_key, jfn)
+        results.update(jfn(ords_in, rels_in, exts_in))
 
     # Torch fallback for ops with no lowering: replay on host, transfer with
     # the planned sharding.  Per-tensor, so peak host RAM ≈ largest param.

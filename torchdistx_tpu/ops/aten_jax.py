@@ -11,7 +11,8 @@ functional translation of the reference's mutable-storage replay
 
 RNG lowering note: torch's in-place RNG ops (``uniform_``, ``normal_``) draw
 from the global Philox stream; here each op draws from
-``fold_in(base_key, op_nr)`` — deterministic, materialization-order
+``fold_in`` streams (name-keyed or tape-relative, see materialize.py) —
+deterministic, materialization-order
 independent, and shard-consistent under SPMD (every shard of a param sees the
 same key and XLA partitions the generation).  Statistical, not bitwise,
 parity with torch eager init — by design.

@@ -1,0 +1,63 @@
+"""Persistent XLA compilation cache for init programs.
+
+Materialization cost is dominated by XLA compile time (the init program
+itself executes in milliseconds); the grouped materializer deliberately emits
+HLO that is stable across processes — RNG streams enter as traced ``op_nr``
+inputs rather than baked constants (see _tape.py's tape-relative numbering)
+— precisely so JAX's persistent compilation cache can hit on re-runs.  A
+training job that restarts (preemption, resharding, hyperparameter sweeps)
+re-materializes the same architecture and pays only trace + cache-lookup
+time.
+
+Enabled on first materialization unless the user configured a cache dir
+themselves (their setting wins) or disabled it via
+``TDX_NO_COMPILATION_CACHE=1``.  The default location honors
+``JAX_COMPILATION_CACHE_DIR`` and falls back to
+``~/.cache/torchdistx_tpu/xla_cache``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_done = False
+
+
+def ensure_compilation_cache() -> None:
+    global _done
+    if _done:
+        return
+    with _lock:
+        if _done:
+            return
+        _done = True
+        if os.environ.get("TDX_NO_COMPILATION_CACHE"):
+            return
+        try:
+            import jax
+
+            if jax.config.jax_compilation_cache_dir:
+                return  # user configured their own — leave it alone
+            if jax.default_backend() == "cpu":
+                # CPU executables are AOT-compiled against the build host's
+                # exact machine features; reloading them elsewhere warns (or
+                # SIGILLs).  The cache's value is on accelerators, where
+                # executables are device-kind-portable.
+                return
+            cache_dir = os.environ.get(
+                "JAX_COMPILATION_CACHE_DIR"
+            ) or os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # Init programs are individually cheap to compile (~100ms per
+            # unique signature) but numerous; cache everything.
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            # Cache is a pure optimization — never fail materialization
+            # over it (read-only HOME, old jax flag names, ...).
+            pass
