@@ -503,12 +503,15 @@ def materialize_tensor_jax(
         )
 
     _check_guards_of(record.node)
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
+    from .utils.compilation_cache import cache_everything
 
-        sharding = NamedSharding(mesh, spec or PartitionSpec())
-        return jax.jit(compute, out_shardings=sharding)()
-    return jax.jit(compute)()
+    with cache_everything():
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, spec or PartitionSpec())
+            return jax.jit(compute, out_shardings=sharding)()
+        return jax.jit(compute)()
 
 
 def _check_guards_of(target: OpNode) -> None:
@@ -578,8 +581,16 @@ _EXEC_CACHE_MAX = 16
 exec_cache_hits = 0  # introspection for tests/benchmarks
 
 
+def _exec_cache_enabled() -> bool:
+    import os
+
+    return not os.environ.get("TDX_NO_EXEC_CACHE")
+
+
 def _exec_cache_get(key):
     global exec_cache_hits
+    if not _exec_cache_enabled():
+        return None
     fn = _EXEC_CACHE.get(key)
     if fn is not None:
         exec_cache_hits += 1
@@ -587,9 +598,7 @@ def _exec_cache_get(key):
 
 
 def _exec_cache_put(key, fn) -> None:
-    import os
-
-    if os.environ.get("TDX_NO_EXEC_CACHE"):
+    if not _exec_cache_enabled():
         return
     if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
         _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
@@ -735,8 +744,7 @@ def materialize_module_jax(
             for g in group_list
         ]
 
-        def compute(ords_in, rels_in, exts_in):
-            base_key = _base_key(seed, rng_impl)
+        def compute(base_key, ords_in, rels_in, exts_in):
             fold = jax.vmap(
                 jax.vmap(
                     lambda o, r: jax.random.fold_in(
@@ -801,6 +809,9 @@ def materialize_module_jax(
         # Executable-cache key: full program identity.  Only when every
         # target is grouped — the fused path bakes instance data into the
         # trace, so its programs are not reusable.
+        # Program identity excludes the seed: the base key enters the
+        # program as a traced input, so one executable serves a whole
+        # seed sweep.
         exec_key = None
         if group_list and not fused_names and not unsupported:
             try:
@@ -808,7 +819,6 @@ def materialize_module_jax(
                     tuple(
                         (g["key"], tuple(g["names"])) for g in group_list
                     ),
-                    seed,
                     rng_impl,
                     None
                     if mesh is None
@@ -827,22 +837,31 @@ def materialize_module_jax(
             except TypeError:
                 exec_key = None
 
+        base_key = _base_key(seed, rng_impl)
         jfn = _exec_cache_get(exec_key) if exec_key is not None else None
         if jfn is None:
+            from .utils.compilation_cache import cache_everything
+
             if shardings is not None:
                 jfn = jax.jit(compute, out_shardings=shardings)
             else:
                 jfn = jax.jit(compute)
-            if exec_key is not None:
-                # Cache the AOT-compiled executable, not the jit wrapper:
-                # the wrapper would pin `compute`'s closure — the whole
-                # tape (OpNodes, deep-copied args, fakes) — for the cache
-                # entry's lifetime.  The compiled object holds only the
-                # executable; input shapes/dtypes are fixed by the group
-                # signatures in the key, so the AOT call always matches.
-                jfn = jfn.lower(ords_in, rels_in, exts_in).compile()
-                _exec_cache_put(exec_key, jfn)
-        results.update(jfn(ords_in, rels_in, exts_in))
+            with cache_everything():
+                if exec_key is not None:
+                    # Cache the AOT-compiled executable, not the jit
+                    # wrapper: the wrapper would pin `compute`'s closure —
+                    # the whole tape (OpNodes, deep-copied args, fakes) —
+                    # for the cache entry's lifetime.  The compiled object
+                    # holds only the executable; input shapes/dtypes are
+                    # fixed by the group signatures in the key (and the key
+                    # aval by rng_impl), so the AOT call always matches.
+                    jfn = jfn.lower(
+                        base_key, ords_in, rels_in, exts_in
+                    ).compile()
+                    _exec_cache_put(exec_key, jfn)
+                results.update(jfn(base_key, ords_in, rels_in, exts_in))
+        else:
+            results.update(jfn(base_key, ords_in, rels_in, exts_in))
 
     # Torch fallback for ops with no lowering: replay on host, transfer with
     # the planned sharding.  Per-tensor, so peak host RAM ≈ largest param.

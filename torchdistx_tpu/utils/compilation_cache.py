@@ -2,12 +2,12 @@
 
 Materialization cost is dominated by XLA compile time (the init program
 itself executes in milliseconds); the grouped materializer deliberately emits
-HLO that is stable across processes — RNG streams enter as traced ``op_nr``
-inputs rather than baked constants (see _tape.py's tape-relative numbering)
-— precisely so JAX's persistent compilation cache can hit on re-runs.  A
-training job that restarts (preemption, resharding, hyperparameter sweeps)
-re-materializes the same architecture and pays only trace + cache-lookup
-time.
+HLO that is stable across processes — the RNG base key and per-node stream
+identities enter as traced inputs rather than baked constants (see _tape.py's
+tape-relative numbering) — precisely so JAX's persistent compilation cache
+can hit on re-runs.  A training job that restarts (preemption, resharding,
+hyperparameter sweeps) re-materializes the same architecture and pays only
+trace + cache-lookup time.
 
 Enabled on first materialization unless the user configured a cache dir
 themselves (their setting wins) or disabled it via
@@ -51,13 +51,47 @@ def ensure_compilation_cache() -> None:
             ) or os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
-            # Init programs are individually cheap to compile (~100ms per
-            # unique signature) but numerous; cache everything.
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0
-            )
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         except Exception:
             # Cache is a pure optimization — never fail materialization
             # over it (read-only HOME, old jax flag names, ...).
             pass
+
+
+class cache_everything:
+    """Scope JAX's persistent-cache admission thresholds to one region.
+
+    Init programs are individually cheap to compile (~100ms per unique
+    signature) — below JAX's default min-compile-time admission bar — but
+    numerous, so the materializer wants them all cached.  Applying the
+    thresholds process-globally would also serialize every tiny throwaway
+    jit and every multi-hundred-MB train-step executable the *user*
+    compiles; scoping keeps the aggressive admission local to
+    materialization.
+    """
+
+    _FLAGS = (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    )
+
+    def __enter__(self):
+        self._saved = []
+        try:
+            import jax
+
+            for name, value in self._FLAGS:
+                self._saved.append((name, getattr(jax.config, name)))
+                jax.config.update(name, value)
+        except Exception:
+            self._saved = []
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            import jax
+
+            for name, value in self._saved:
+                jax.config.update(name, value)
+        except Exception:
+            pass
+        return False
