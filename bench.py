@@ -238,6 +238,62 @@ def bench_train_step():
     return out
 
 
+def bench_flash_attention(s=16384, b=1, h=8, d=128):
+    """Long-context flash attention fwd+bwd at S=16k on one chip.
+
+    The kernel streams KV through VMEM scratch (O(bq·d + bkv·d) VMEM at any
+    S); this probe is the perf ratchet for the long-context regime.  Sync is
+    via host transfer (block_until_ready alone does not reliably block on
+    the tunneled backend).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchdistx_tpu.ops.pallas.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (b, s, h, d),
+                          dtype=jnp.bfloat16)
+        for i in range(3)
+    )
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    # All three grads, so neither backward kernel is dead-code-eliminated
+    # out of the timed program.
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    gq, gk, gv = step(q, k, v)
+    float(gq.astype(jnp.float32).sum())
+    # Iterations chain on device (grads feed back into the inputs) with ONE
+    # host sync at the end: per-iteration syncs would measure tunnel
+    # round-trips, not kernel time.
+    n = 20
+    t0 = time.perf_counter()
+    x, y, z = q, k, v
+    for _ in range(n):
+        gq, gk, gv = step(x, y, z)
+        x = gq.astype(x.dtype)
+        y = gk.astype(y.dtype)
+        z = gv.astype(z.dtype)
+    float(x.astype(jnp.float32).sum())
+    dt = (time.perf_counter() - t0) / n
+    # Causal fwd QK^T+PV = 2·2·b·h·s²·d·½; bwd ≈ 2.5× fwd (dq,dk,dv + p
+    # recompute).
+    flops = 3.5 * 2.0 * b * h * s * s * d
+    kind = jax.devices()[0].device_kind
+    peak = _peak_tflops(kind)
+    out = {
+        "seq_len": s,
+        "fwd_bwd_ms": round(dt * 1e3, 2),
+        "tflops_per_s": round(flops / dt / 1e12, 2),
+    }
+    if peak is not None:
+        out["attn_mfu"] = round(flops / dt / (peak * 1e12), 4)
+    return out
+
+
 def main():
     import jax
     import torch.nn as nn
@@ -265,6 +321,10 @@ def main():
         train = bench_train_step()
     except Exception as e:  # noqa: BLE001 — report, don't sink the bench
         train = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        flash16k = bench_flash_attention()
+    except Exception as e:  # noqa: BLE001
+        flash16k = {"error": f"{type(e).__name__}: {e}"}
 
     print(
         json.dumps(
@@ -278,6 +338,7 @@ def main():
                     "gpt2small_124m_f32": small,
                     "resnet50_25m_f32": resnet,
                     "train_step_llama_350m_pallas": train,
+                    "flash_attention_16k": flash16k,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),
                 },
