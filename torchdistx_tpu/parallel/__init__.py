@@ -1,4 +1,9 @@
 from .mesh import make_mesh, MeshSpec  # noqa: F401
+from .distributed import (  # noqa: F401
+    ProcessInfo,
+    initialize,
+    make_hybrid_mesh,
+)
 from .sharding import (  # noqa: F401
     fsdp_plan,
     fsdp_over,
