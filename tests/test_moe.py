@@ -120,3 +120,50 @@ def test_ep_sharded_matches_unsharded(cfg, params):
         lambda p, t: moe.forward(p, t, cfg, attn_impl="jnp")
     )(sharded, tokens)
     assert jnp.allclose(ref, out, atol=1e-4)
+
+
+def test_pipeline_moe_forward_matches_dense(cfg, params):
+    """pp+MoE: logits match the dense path when capacity is ample (routing
+    happens per microbatch, but with no drops the computation is
+    identical); the aux channel survives the pipeline."""
+    big_cap = dataclasses.replace(cfg, capacity_factor=4.0)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(5), (4, 16), 0, cfg.vocab_size
+    )
+    ref, ref_aux = moe.forward(
+        params, tokens, big_cap, attn_impl="jnp", return_aux=True
+    )
+    mesh = make_mesh(axis_names=("fsdp", "pp"), shape=(4, 2))
+    out, aux = jax.jit(
+        lambda p, t: moe.forward(
+            p, t, big_cap, attn_impl="jnp", mesh=mesh, pp_axis="pp",
+            n_microbatches=2, return_aux=True,
+        )
+    )(params, tokens)
+    assert jnp.allclose(ref, out, atol=1e-4)
+    assert jnp.isfinite(aux) and float(aux) > 0.0
+    # Per-microbatch aux is an estimator of the full-batch aux.
+    assert abs(float(aux) - float(ref_aux)) < 0.5
+
+
+def test_pipeline_expert_parallel_train_step(cfg):
+    """pp × ep on one mesh: the NotImplementedError combination of round 1."""
+    cfg2 = dataclasses.replace(cfg, n_layers=2)
+    mesh = make_mesh(MeshSpec(pp=2, ep=4))
+    init_fn, step_fn = ts.make_train_step(
+        cfg2, mesh, optax.sgd(0.1), model=moe, pp_axis="pp",
+        n_microbatches=2, attn_impl="jnp",
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    assert state.params["layers"]["e_gate"].sharding.spec[:2] == ("pp", "ep")
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg2.vocab_size),
+        ts.batch_sharding(mesh),
+    )
+    batch = {"tokens": tokens, "targets": tokens}
+    losses = []
+    for _ in range(4):
+        state, m = step_fn(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()

@@ -214,36 +214,76 @@ def forward(
     n_microbatches: int = 1,
     return_aux: bool = False,
 ):
-    """Token ids → logits; MoE FFN per block.  ``pp_axis`` unsupported for
-    MoE in this version (aux-loss accumulation crosses stages)."""
-    if pp_axis is not None:
-        raise NotImplementedError("pipeline + MoE not supported yet")
+    """Token ids → logits; MoE FFN per block.
+
+    ``pp_axis`` runs the blocks through the GPipe pipeline with the router
+    aux loss travelling as a per-row side channel in the pipelined
+    activation pytree.  Under pp, routing/capacity are computed per
+    *microbatch* (each stage sees ``B/M`` tokens) — same semantics as
+    training on microbatches, documented divergence from the dense path
+    (equal logits when capacity is ample; aux becomes the mean of
+    per-microbatch aux losses).
+    """
     b, s = tokens.shape
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
     positions = jnp.arange(s)[None]
 
-    def block(carry, lp):
-        x, aux_sum = carry
+    def block_core(x, aux_sum, lp):
+        bb = x.shape[0]
         h = llama_mod._rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = (h @ lp["wq"]).reshape(bb, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
         q = llama_mod._rope(q, positions, cfg.rope_theta)
         k = llama_mod._rope(k, positions, cfg.rope_theta)
         attn = attention(
             q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
         )
-        x = x + attn.reshape(b, s, -1) @ lp["wo"]
+        x = x + attn.reshape(bb, s, -1) @ lp["wo"]
         h = llama_mod._rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         ffn, aux = moe_ffn(
             h, lp["router"], lp["e_gate"], lp["e_up"], lp["e_down"], cfg
         )
-        return (x + ffn, aux_sum + aux), None
+        return x + ffn, aux_sum + aux
 
-    body = jax.checkpoint(block) if cfg.remat else block
-    (x, aux_sum), _ = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)), params["layers"]
-    )
+    if pp_axis is not None:
+        from ..parallel.pipeline import pipeline_forward
+
+        def pp_block(act, lp):
+            # aux channel: one value per batch row (every row of a
+            # microbatch carries that microbatch's running aux sum).
+            x_new, aux_new = block_core(
+                act["h"], act["aux"][:, 0], lp
+            )
+            return {
+                "h": x_new,
+                "aux": jnp.broadcast_to(
+                    aux_new[..., None], act["aux"].shape
+                ),
+            }
+
+        body = jax.checkpoint(pp_block) if cfg.remat else pp_block
+        out = pipeline_forward(
+            {"h": x, "aux": jnp.zeros((b, 1), jnp.float32)},
+            params["layers"],
+            body,
+            mesh=mesh,
+            axis=pp_axis,
+            n_microbatches=n_microbatches,
+        )
+        x = out["h"]
+        # Each row holds its microbatch's Σ_layers aux; the mean over rows
+        # is the microbatch-mean aux sum.
+        aux_sum = out["aux"].mean()
+    else:
+        def block(carry, lp):
+            x, aux_sum = carry
+            return block_core(x, aux_sum, lp), None
+
+        body = jax.checkpoint(block) if cfg.remat else block
+        (x, aux_sum), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        )
     x = llama_mod._rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
     logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
         jnp.float32
@@ -268,7 +308,8 @@ def loss_fn(
     """Cross-entropy + router load-balancing aux loss."""
     logits, aux = forward(
         params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
-        attn_impl=attn_impl, pp_axis=pp_axis, return_aux=True,
+        attn_impl=attn_impl, pp_axis=pp_axis,
+        n_microbatches=n_microbatches, return_aux=True,
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

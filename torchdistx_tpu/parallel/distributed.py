@@ -74,7 +74,14 @@ def initialize(
     global _initialized
     import jax
 
-    if not _initialized:
+    already = _initialized
+    if not already:
+        # Adopt a runtime initialized by an outer launcher/framework.
+        is_init = getattr(jax.distributed, "is_initialized", None)
+        if is_init is not None:
+            already = bool(is_init())
+
+    if not already:
         kwargs = {}
         if coordinator_address is not None:
             kwargs["coordinator_address"] = coordinator_address
@@ -87,13 +94,13 @@ def initialize(
         try:
             jax.distributed.initialize(**kwargs)
         except RuntimeError as e:
-            # Already initialized (by the launcher, a framework, or a prior
-            # call) — adopt the existing runtime.  JAX's message is
-            # "distributed.initialize should only be called once.".
+            # Double-init fallback for jax versions without
+            # is_initialized(); the message is "distributed.initialize
+            # should only be called once.".
             msg = str(e).lower()
             if "already" not in msg and "once" not in msg:
                 raise
-        _initialized = True
+    _initialized = True
     return world_info()
 
 
@@ -131,10 +138,12 @@ def make_hybrid_mesh(
     crosses DCN exactly ``log`` once while fsdp/tp collectives stay inside
     a slice — the SlowMo intra/inter split on TPU interconnect.
 
-    Falls back to :func:`make_mesh` when ``dcn`` is trivial.  Uses
-    ``mesh_utils.create_hybrid_device_mesh`` for slice-aware device
-    ordering when available; otherwise assembles granules by
-    ``slice_index``/``process_index`` (virtual/CPU meshes — the test rig).
+    Falls back to :func:`make_mesh` when ``dcn`` is trivial.  Devices with
+    real ``slice_index`` metadata (TPU pods) are placed by
+    ``mesh_utils.create_hybrid_device_mesh`` (ICI-topology-aware; genuine
+    topology errors propagate); otherwise granules assemble by
+    ``process_index`` or a contiguous split (virtual/CPU meshes — the
+    test rig).
     """
     import jax
     import numpy as np
@@ -145,8 +154,10 @@ def make_hybrid_mesh(
         return make_mesh(ici, devices=devices)
 
     # Canonical axis order with per-axis (dcn, ici) factors.
+    from .mesh import AXIS_ORDER
+
     names, ici_sizes, dcn_sizes = [], [], []
-    for name in ("dp", "pp", "fsdp", "tp", "sp", "ep"):
+    for name in AXIS_ORDER:
         i = getattr(ici, name)
         d = getattr(dcn, name)
         if i > 1 or d > 1:
@@ -162,15 +173,17 @@ def make_hybrid_mesh(
 
     from jax.sharding import Mesh
 
-    try:
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        # Real slice metadata (TPU pods): use jax's slice- and
+        # ICI-topology-aware placement, and let genuine topology errors
+        # (unmappable ici factors, wrong dcn extent) propagate instead of
+        # degrading to a metadata-blind layout.
         from jax.experimental import mesh_utils
 
         dev_array = mesh_utils.create_hybrid_device_mesh(
             tuple(ici_sizes), tuple(dcn_sizes), devices=list(devices)
         )
         return Mesh(dev_array, tuple(names))
-    except Exception:
-        pass
 
     granules = _slice_granules(list(devices))
     n_slices = int(np.prod(dcn_sizes))

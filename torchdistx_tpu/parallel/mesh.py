@@ -24,6 +24,11 @@ from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
 
+# Canonical DCN-major → ICI-minor axis order, shared by every mesh builder
+# (make_mesh, distributed.make_hybrid_mesh).
+AXIS_ORDER: Tuple[str, ...] = ("dp", "pp", "fsdp", "tp", "sp", "ep")
+
+
 @dataclass(frozen=True)
 class MeshSpec:
     """Named mesh shape, e.g. ``MeshSpec(dp=2, fsdp=2, tp=2)``."""
@@ -39,12 +44,7 @@ class MeshSpec:
         return tuple(
             (name, size)
             for name, size in (
-                ("dp", self.dp),
-                ("pp", self.pp),
-                ("fsdp", self.fsdp),
-                ("tp", self.tp),
-                ("sp", self.sp),
-                ("ep", self.ep),
+                (name, getattr(self, name)) for name in AXIS_ORDER
             )
             if size > 1
         ) or (("dp", 1),)

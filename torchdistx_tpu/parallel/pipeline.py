@@ -81,11 +81,15 @@ def pipeline_forward(
     axis: str = "pp",
     n_microbatches: int,
 ):
-    """Run stacked layers over ``x (B, ...)`` with a GPipe schedule.
+    """Run stacked layers over ``x`` with a GPipe schedule.
 
-    ``layer_params``: pytree with leading layer dim on every leaf, sharded
-    ``P(axis, ...)`` (see :func:`stage_specs`).  ``block_fn(x, lp) -> x``
-    is one transformer block given one layer's (unstacked) params.
+    ``x``: an activation array ``(B, ...)`` or a *pytree* of them (every
+    leaf with the same leading batch dim) — side channels like an MoE
+    router aux-loss accumulator travel through the pipeline alongside the
+    hidden state.  ``layer_params``: pytree with leading layer dim on every
+    leaf, sharded ``P(axis, ...)`` (see :func:`stage_specs`).
+    ``block_fn(x, lp) -> x`` is one transformer block given one layer's
+    (unstacked) params, preserving the pytree structure of ``x``.
     ``n_microbatches`` must divide the global batch ``B``.
 
     Only the ``axis`` dimension is manual inside the ``shard_map`` — every
@@ -98,20 +102,26 @@ def pipeline_forward(
     if axis not in names:
         raise ValueError(f"mesh has no axis {axis!r} (axes: {mesh.axis_names})")
     n_stages = mesh.shape[axis]
-    if x.shape[0] % n_microbatches:
+    leaves = jax.tree.leaves(x)
+    batch = leaves[0].shape[0]
+    if any(l.shape[0] != batch for l in leaves):
+        raise ValueError("all activation leaves must share the batch dim")
+    if batch % n_microbatches:
         raise ValueError(
-            f"batch {x.shape[0]} not divisible by {n_microbatches} microbatches"
+            f"batch {batch} not divisible by {n_microbatches} microbatches"
         )
-    x_spec = P(*([None] * x.ndim))
+    x_spec = jax.tree.map(lambda l: P(*([None] * l.ndim)), x)
     param_specs_local = jax.tree.map(
         lambda l: P(axis, *([None] * (l.ndim - 1))), layer_params
     )
 
     def body(x_local, params_local):
-        # x_local: (B_local, ...); params_local: (L/P, ...) for my stage.
+        # x_local leaves: (B_local, ...); params_local: (L/P, ...) stage.
         p = jax.lax.axis_index(axis)
-        bt = x_local.shape[0] // n_microbatches
-        micro = x_local.reshape((n_microbatches, bt) + x_local.shape[1:])
+        bt = batch // n_microbatches
+        micro = jax.tree.map(
+            lambda l: l.reshape((n_microbatches, bt) + l.shape[1:]), x_local
+        )
 
         def run_stage(act):
             def scan_block(h, lp):
@@ -122,18 +132,22 @@ def pipeline_forward(
 
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         n_ticks = n_microbatches + n_stages - 1
-        out0 = jnp.zeros_like(micro)
-        carry0 = jnp.zeros_like(micro[0])
+        out0 = jax.tree.map(jnp.zeros_like, micro)
+        carry0 = jax.tree.map(lambda l: jnp.zeros_like(l[0]), micro)
 
         def tick(carry, t):
             incoming, outputs = carry
             m = t - p  # microbatch this stage works on at tick t
             valid = (m >= 0) & (m < n_microbatches)
             m_idx = jnp.clip(m, 0, n_microbatches - 1)
-            stage_in = jnp.where(
-                p == 0, jax.lax.dynamic_index_in_dim(micro, m_idx, 0,
-                                                     keepdims=False),
-                incoming,
+            stage_in = jax.tree.map(
+                lambda mic, inc: jnp.where(
+                    p == 0,
+                    jax.lax.dynamic_index_in_dim(mic, m_idx, 0,
+                                                 keepdims=False),
+                    inc,
+                ),
+                micro, incoming,
             )
             # Gate the stage behind the validity predicate: ramp-up/drain
             # ticks take the identity branch, skipping the stage's FLOPs in
@@ -146,15 +160,22 @@ def pipeline_forward(
             # whose group spans pp must never move inside a branch.
             y = jax.lax.cond(valid, run_stage, lambda act: act, stage_in)
             # Last stage banks its (valid) result.
-            bank = jnp.where(valid & (p == n_stages - 1), y, 0.0)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs,
-                outputs[m_idx] + bank.astype(outputs.dtype),
-                m_idx,
-                0,
+            outputs = jax.tree.map(
+                lambda out, yl: jax.lax.dynamic_update_index_in_dim(
+                    out,
+                    out[m_idx]
+                    + jnp.where(
+                        valid & (p == n_stages - 1), yl, 0.0
+                    ).astype(out.dtype),
+                    m_idx,
+                    0,
+                ),
+                outputs, y,
             )
             # Hand activations to the next stage.
-            incoming = jax.lax.ppermute(y, axis, perm)
+            incoming = jax.tree.map(
+                lambda yl: jax.lax.ppermute(yl, axis, perm), y
+            )
             return (incoming, outputs), None
 
         (_, outputs), _ = jax.lax.scan(
@@ -163,7 +184,9 @@ def pipeline_forward(
         # Only the last stage holds real outputs; make them visible on all
         # stages (they're zeros elsewhere, so a psum is a broadcast).
         outputs = jax.lax.psum(outputs, axis)
-        return outputs.reshape(x_local.shape)
+        return jax.tree.map(
+            lambda out, l: out.reshape(l.shape), outputs, x_local
+        )
 
     return _shard_map(
         body, mesh, in_specs=(x_spec, param_specs_local), out_specs=x_spec,
