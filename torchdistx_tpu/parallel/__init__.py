@@ -4,6 +4,7 @@ from .distributed import (  # noqa: F401
     initialize,
     make_hybrid_mesh,
 )
+from .fit import fit  # noqa: F401
 from .sharding import (  # noqa: F401
     fsdp_plan,
     fsdp_over,

@@ -1,0 +1,81 @@
+"""Elastic training loop: periodic checkpointing + automatic resume.
+
+The reference leaves the training loop to the user and checkpoints only the
+SlowMo optimizer state (slowmo_optimizer.py:156-189).  On a preemptible TPU
+fleet the loop itself is part of the framework's job: run ``n_steps``,
+checkpoint every ``checkpoint_every`` steps, and — after a preemption or a
+re-shard — resume from the latest checkpoint, *including onto a different
+mesh*: restore targets are abstract arrays carrying the new mesh's
+shardings, so orbax reads each shard straight to its new owning device
+(no full-tensor host round-trip; see utils/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+__all__ = ["fit"]
+
+
+def fit(
+    init_fn: Callable,
+    step_fn: Callable,
+    batches: Iterable[Any],
+    *,
+    key,
+    n_steps: int,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 100,
+    on_metrics: Optional[Callable[[int, Any], None]] = None,
+):
+    """Run up to ``n_steps`` optimizer steps, resuming from checkpoints.
+
+    ``init_fn(key) -> state`` and ``step_fn(state, batch) -> (state,
+    metrics)`` are the pair built by :func:`make_train_step` (any functions
+    with those signatures work).  ``batches`` yields one batch per step;
+    steps already completed by a restored checkpoint are skipped by
+    *advancing* the iterator, so a deterministic data stream stays aligned
+    with the optimizer step count after resume.
+
+    Returns ``(state, last_metrics)``.
+    """
+    import jax
+
+    state = None
+    start = 0
+    ckptr = None
+    if checkpoint_dir is not None:
+        from ..utils.checkpoint import Checkpointer
+
+        ckptr = Checkpointer(checkpoint_dir)
+        # Abstract restore target: init_fn is jitted with out_shardings, so
+        # eval_shape leaves already carry the mesh shardings — no init
+        # compute, and never two full states in HBM during restore.
+        abstract = jax.eval_shape(init_fn, key)
+        step, restored = ckptr.restore_latest(
+            target=abstract,
+            shardings=jax.tree.map(lambda l: l.sharding, abstract),
+        )
+        if step is not None:
+            state, start = restored, step
+    if state is None:
+        state = init_fn(key)
+
+    metrics = None
+    if start >= n_steps:
+        return state, metrics
+    it = iter(batches)
+    for i, batch in enumerate(it):
+        if i >= n_steps:
+            break
+        if i < start:
+            continue  # replay the data stream up to the resume point
+        state, metrics = step_fn(state, batch)
+        done = i + 1
+        if on_metrics is not None:
+            on_metrics(done, metrics)
+        if ckptr is not None and (
+            done % checkpoint_every == 0 or done == n_steps
+        ):
+            ckptr.save(done, state)
+    return state, metrics
