@@ -115,6 +115,26 @@ class TestRingAttention:
         )(q, k, v)
         assert jnp.allclose(ref, out, atol=1e-5)
 
+    def test_causal_skips_future_blocks(self, mesh):
+        """Future K/V ring blocks take a lax.cond identity branch; the
+        compiled module retains a real HLO conditional (skipped, not
+        select-executed) in forward and backward."""
+        q, k, v = _qkv()
+        fwd = jax.jit(
+            lambda q, k, v: ring_attention(
+                q, k, v, mesh=mesh, axis="sp", causal=True
+            )
+        )
+        assert "conditional" in fwd.lower(q, k, v).compile().as_text()
+        bwd = jax.jit(jax.grad(
+            lambda q, k, v: (
+                ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True)
+                ** 2
+            ).sum(),
+            argnums=(0,),
+        ))
+        assert "conditional" in bwd.lower(q, k, v).compile().as_text()
+
     def test_grads_match_reference(self, mesh):
         q, k, v = _qkv(s=32)
         g_ref = jax.grad(
