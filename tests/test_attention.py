@@ -115,6 +115,49 @@ class TestRingAttention:
         )(q, k, v)
         assert jnp.allclose(ref, out, atol=1e-5)
 
+    def test_zigzag_matches_reference(self, mesh):
+        q, k, v = _qkv()  # s=64 = 2·sp·8
+        ref = mha_reference(q, k, v, causal=True)
+        out = jax.jit(
+            lambda q, k, v: ring_attention(
+                q, k, v, mesh=mesh, axis="sp", causal=True,
+                schedule="zigzag",
+            )
+        )(q, k, v)
+        assert jnp.allclose(ref, out, atol=1e-5)
+
+    def test_zigzag_grads_match_reference(self, mesh):
+        q, k, v = _qkv()
+        g_ref = jax.grad(
+            lambda q, k, v: (mha_reference(q, k, v, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_z = jax.jit(jax.grad(
+            lambda q, k, v: (
+                ring_attention(
+                    q, k, v, mesh=mesh, axis="sp", causal=True,
+                    schedule="zigzag",
+                ) ** 2
+            ).sum(),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+        for a, b in zip(g_ref, g_z):
+            assert jnp.allclose(a, b, atol=1e-4)
+
+    def test_zigzag_validation(self, mesh):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="causal-only"):
+            ring_attention(
+                q, k, v, mesh=mesh, axis="sp", causal=False,
+                schedule="zigzag",
+            )
+        q2, k2, v2 = _qkv(s=36)  # not divisible by 2·sp=8
+        with pytest.raises(ValueError, match="divisible"):
+            ring_attention(
+                q2, k2, v2, mesh=mesh, axis="sp", causal=True,
+                schedule="zigzag",
+            )
+
     def test_causal_skips_future_blocks(self, mesh):
         """Future K/V ring blocks take a lax.cond identity branch; the
         compiled module retains a real HLO conditional (skipped, not

@@ -55,11 +55,12 @@ class TestTrainStep:
             results.append(float(m["loss"]))
         assert abs(results[0] - results[1]) < 1e-3
 
-    def test_sequence_parallel_matches_single(self, cfg):
+    @pytest.mark.parametrize("ring_impl", ["ring", "ring_zigzag"])
+    def test_sequence_parallel_matches_single(self, cfg, ring_impl):
         tokens_shape = (8, 64)
         mesh_sp = make_mesh(MeshSpec(fsdp=2, sp=4))
         init_fn, step_fn = ts.make_train_step(
-            cfg, mesh_sp, optax.sgd(0.1), seq_axis="sp", attn_impl="ring"
+            cfg, mesh_sp, optax.sgd(0.1), seq_axis="sp", attn_impl=ring_impl
         )
         state = init_fn(jax.random.PRNGKey(0))
         batch = _batch(cfg, ts.batch_sharding(mesh_sp), tokens_shape)

@@ -117,10 +117,12 @@ def attention(
 ):
     """Dispatching attention entry point used by the model stack.
 
-    ``impl``: ``"auto" | "jnp" | "pallas" | "ring"``.  ``auto`` = ring iff
-    ``seq_axis`` is set (sequence/context parallelism); else pallas on TPU
-    when ``mesh`` is None (single-chip); else jnp (XLA-fused, partitions
-    correctly under a mesh).
+    ``impl``: ``"auto" | "jnp" | "pallas" | "ring" | "ring_zigzag"``.
+    ``auto`` = ring iff ``seq_axis`` is set (sequence/context parallelism);
+    else pallas on TPU when ``mesh`` is None (single-chip); else jnp
+    (XLA-fused, partitions correctly under a mesh).  ``ring_zigzag`` is the
+    load-balanced causal ring schedule (see
+    :mod:`torchdistx_tpu.parallel.ring_attention`).
     """
     if impl == "auto":
         if seq_axis is not None:
@@ -136,14 +138,22 @@ def attention(
             impl = "pallas"
         else:
             impl = "jnp"
-    if impl == "ring":
+    if impl in ("ring", "ring_zigzag"):
         from ..parallel.ring_attention import ring_attention
 
         if mesh is None or seq_axis is None:
             raise ValueError("ring attention needs mesh= and seq_axis=")
-        return ring_attention(q, k, v, mesh=mesh, axis=seq_axis, causal=causal)
+        return ring_attention(
+            q, k, v, mesh=mesh, axis=seq_axis, causal=causal,
+            schedule="zigzag" if impl == "ring_zigzag" else "contiguous",
+        )
     if impl == "pallas":
         from .pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
+    if impl != "jnp":
+        raise ValueError(
+            f"unknown attention impl: {impl!r} "
+            "(expected auto|jnp|pallas|ring|ring_zigzag)"
+        )
     return mha_reference(q, k, v, causal=causal)

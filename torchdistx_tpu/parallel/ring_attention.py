@@ -9,11 +9,17 @@ contribution (the blockwise-attention recurrence of Ring Attention,
 arXiv:2310.01889).  After ``sp`` steps every Q block has attended to the full
 sequence; peak memory per device is O(S/sp · S/sp) logits instead of O(S²).
 Causal runs skip fully-future blocks behind a ``lax.cond`` — a device
-computes only its ``(idx+1)`` lower-triangle steps, forward and transposed
-backward.  This saves FLOPs/energy, not wall-clock: with contiguous block
-assignment the last device computes on every step and the unconditional
-per-step ``ppermute`` keeps the ring in lockstep with it (a
-zigzag/striped block assignment would balance the load; future work).
+computes only its lower-triangle steps, forward and transposed backward.
+Under the default *contiguous* block assignment this saves FLOPs/energy
+but not wall-clock (the last device computes on every step and the
+unconditional per-step ``ppermute`` keeps the ring in lockstep with it).
+``schedule="zigzag"`` rebalances causal work for wall-clock too: each
+device owns one *early* and one *late* half-block (device ``i`` holds
+halves ``i`` and ``2n-1-i``), so every device computes exactly two
+half-block contributions per ring step (three on its diagonal step) —
+the per-step critical path drops from one full block to ~half.  The
+zigzag sequence permutation is applied/inverted outside the ``shard_map``
+(one resharding gather each way).
 
 Implemented as ``shard_map`` over the mesh + ``lax.scan`` over ring steps, so
 it nests inside the jitted train step and is reverse-differentiable (scan and
@@ -141,6 +147,107 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
     return out.astype(q.dtype)
 
 
+def _zigzag_ring_body(q, k, v, *, axis: str):
+    """Balanced causal ring body: per-device q/k/v hold halves (i, 2n-1-i).
+
+    Case analysis per ring step visiting source block ``src`` (half indices
+    ``src`` and ``2n-1-src``), against this device's halves ``idx`` and
+    ``2n-1-idx``:
+
+    * ``q_hi`` vs ``k_lo`` — ``2n-1-idx > src`` always: full, every step;
+    * ``src < idx``  — ``q_lo`` vs ``k_lo`` full;
+    * ``src == idx`` — both diagonal (triangular-masked) pairs;
+    * ``src > idx``  — ``q_hi`` vs ``k_hi`` full
+      (``2n-1-src < 2n-1-idx``);
+    * ``q_lo`` vs ``k_hi`` — ``idx < 2n-1-src`` always: never computed.
+
+    Exactly two half-contributions per step (three on the diagonal step),
+    on every device — the causal load balance the contiguous assignment
+    lacks.
+    """
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, sl, hq, d = q.shape
+    h = sl // 2
+
+    def split(x):
+        return x[:, :h], x[:, h:]
+
+    q_lo, q_hi = split(q)
+
+    def zero_acc():
+        return (
+            jnp.zeros((b, h, hq, d), dtype=jnp.float32),
+            jnp.full((b, h, hq, 1), _NEG_INF, dtype=jnp.float32),
+            jnp.zeros((b, h, hq, 1), dtype=jnp.float32),
+        )
+
+    def full(acc, qh, kh, vh):
+        return _merge(acc, _block_contrib(qh, kh, vh, 0, 0, causal=False))
+
+    def diag(acc, qh, kh, vh):
+        return _merge(acc, _block_contrib(qh, kh, vh, 0, 0, causal=True))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, acc_lo, acc_hi = carry
+        src = (idx - t) % n
+        k_lo, k_hi = split(k_blk)
+        v_lo, v_hi = split(v_blk)
+        acc_hi = full(acc_hi, q_hi, k_lo, v_lo)
+
+        def before(accs):  # src strictly earlier than idx
+            a_lo, a_hi = accs
+            return full(a_lo, q_lo, k_lo, v_lo), a_hi
+
+        def diagonal(accs):
+            a_lo, a_hi = accs
+            return (
+                diag(a_lo, q_lo, k_lo, v_lo),
+                diag(a_hi, q_hi, k_hi, v_hi),
+            )
+
+        def after(accs):  # src strictly later than idx
+            a_lo, a_hi = accs
+            return a_lo, full(a_hi, q_hi, k_hi, v_hi)
+
+        acc_lo, acc_hi = jax.lax.switch(
+            jnp.clip(jnp.sign(src - idx) + 1, 0, 2),
+            [before, diagonal, after],
+            (acc_lo, acc_hi),
+        )
+        k_next = jax.lax.ppermute(k_blk, axis, perm)
+        v_next = jax.lax.ppermute(v_blk, axis, perm)
+        return (k_next, v_next, acc_lo, acc_hi), None
+
+    (_, _, (num_l, m_l, l_l), (num_h, m_h, l_h)), _ = jax.lax.scan(
+        step, (k, v, zero_acc(), zero_acc()), jnp.arange(n)
+    )
+    out_lo = num_l / jnp.maximum(l_l, 1e-30)
+    out_hi = num_h / jnp.maximum(l_h, 1e-30)
+    return jnp.concatenate([out_lo, out_hi], axis=1).astype(q.dtype)
+
+
+def _zigzag_perm(s: int, n: int):
+    """Global seq permutation placing halves (i, 2n-1-i) on device ``i``.
+
+    Returns ``(perm, inv)`` index vectors: ``x_zig = x[:, perm]`` and
+    ``x = x_zig[:, inv]``.
+    """
+    import numpy as np
+
+    h = s // (2 * n)
+    order = []
+    for i in range(n):
+        order.extend(range(i * h, (i + 1) * h))
+        order.extend(range((2 * n - 1 - i) * h, (2 * n - i) * h))
+    perm = np.asarray(order)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(s)
+    return perm, inv
+
+
 def ring_attention(
     q,
     k,
@@ -151,6 +258,7 @@ def ring_attention(
     causal: bool = True,
     batch_axes: Sequence[str] = ("dp", "fsdp"),
     head_axes: Sequence[str] = ("tp",),
+    schedule: str = "contiguous",
 ):
     """Sequence-parallel attention.  Layout ``(B, S, H, D)`` (global shapes).
 
@@ -159,6 +267,19 @@ def ring_attention(
     axes the batch/head dims are sharded over (entries absent from ``mesh``
     are ignored), so the shard_map composes with dp/fsdp/tp sharding without
     forcing reshards.
+
+    ``schedule``: ``"contiguous"`` (default) or ``"zigzag"`` — the
+    load-balanced causal schedule (see module docstring); requires
+    ``causal=True`` and a sequence divisible by ``2·sp``.
+
+    .. note:: zigzag permutes q/k/v in and the output back *per call*
+       (four sequence-global reshards per layer, replayed in backward).
+       The balance win pays when per-device attention compute dominates —
+       long local sequence, large head count; for short sequences the
+       reshard traffic can exceed the saving.  Keeping activations in
+       zigzag order across the whole model (permuting tokens and position
+       ids once at the embedding and inverting at the loss) removes the
+       per-layer cost; not implemented yet.
     """
     names = set(mesh.axis_names)
     if axis not in names:
@@ -166,6 +287,25 @@ def ring_attention(
     batch = tuple(a for a in batch_axes if a in names) or None
     heads = tuple(a for a in head_axes if a in names) or None
     spec = P(batch, axis, heads, None)
+
+    if schedule == "zigzag":
+        if not causal:
+            raise ValueError("zigzag schedule is a causal-only optimization")
+        n = mesh.shape[axis]
+        s = q.shape[1]
+        if s % (2 * n):
+            raise ValueError(
+                f"zigzag needs seq {s} divisible by 2·{axis}={2 * n}"
+            )
+        perm, inv = _zigzag_perm(s, n)
+        qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
+        body = functools.partial(_zigzag_ring_body, axis=axis)
+        out = _shard_map(
+            body, mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )(qz, kz, vz)
+        return jnp.take(out, inv, axis=1)
+    if schedule != "contiguous":
+        raise ValueError(f"unknown schedule: {schedule!r}")
     body = functools.partial(_ring_body, axis=axis, causal=causal)
     return _shard_map(
         body, mesh, in_specs=(spec, spec, spec), out_specs=spec
