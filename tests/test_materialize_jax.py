@@ -297,3 +297,24 @@ def test_tensor_path_cross_tape_streams_distinct():
     v1 = np.asarray(materialize_tensor_jax(t1, seed=0))
     v2 = np.asarray(materialize_tensor_jax(t2, seed=0))
     assert not np.allclose(v1, v2)
+
+
+def test_pow_lowering_values():
+    """pow.Scalar is the one lowering whose FIRST aten arg is the scalar
+    (scalar-base ** tensor-exponent, HF Llama's RoPE inv_freq) — lock the
+    argument order against eager torch."""
+    with di._deferred_init_context():
+        exp = torch.arange(0, 8, 2, dtype=torch.float32) / 8
+        t = 2.0 ** -exp                      # aten.pow.Scalar
+        u = exp ** 2.0                       # aten.pow.Tensor_Scalar
+        w = exp ** torch.full((4,), 3.0)     # aten.pow.Tensor_Tensor
+    exp_e = np.arange(0, 8, 2, dtype=np.float32) / 8
+    np.testing.assert_allclose(
+        np.asarray(materialize_tensor_jax(t)), 2.0 ** -exp_e, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(materialize_tensor_jax(u)), exp_e**2.0, rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(materialize_tensor_jax(w)), exp_e**3.0, rtol=1e-6
+    )

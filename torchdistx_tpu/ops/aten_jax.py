@@ -229,7 +229,10 @@ for _names, _fn in [
       "aten.mul_.Scalar"), lambda a, b: a * b),
     (("aten.div.Tensor", "aten.div_.Tensor", "aten.div.Scalar",
       "aten.div_.Scalar"), lambda a, b: a / b),
-    (("aten.pow.Tensor_Scalar", "aten.pow_.Scalar"), lambda a, b: a**b),
+    # pow.Scalar is scalar-base ** tensor-exponent (HF Llama's RoPE
+    # inv_freq: theta ** -(arange(0, d, 2)/d)).
+    (("aten.pow.Tensor_Scalar", "aten.pow_.Scalar", "aten.pow.Scalar",
+      "aten.pow.Tensor_Tensor"), lambda a, b: a**b),
 ]:
     LOWERINGS.update({n: _binop(_fn) for n in _names})
 
