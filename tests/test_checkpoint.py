@@ -151,3 +151,31 @@ def test_fit_resumes_after_interruption(cfg, tmp_path):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
         )
+
+
+def test_async_save_round_trip(cfg, tmp_path):
+    """wait=False dispatches the save in the background; after
+    wait_until_finished the checkpoint restores identically even though
+    the source state was mutated right after dispatch."""
+    mesh = make_mesh(MeshSpec(dp=8))
+    init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.sgd(0.1))
+    state = init_fn(jax.random.PRNGKey(0))
+    snapshot = jax.tree.map(np.asarray, state.params)
+
+    ck = Checkpointer(str(tmp_path / "async"))
+    ck.save(1, state, wait=False)
+    # Mutate (donate) the live state immediately — step_fn donates its
+    # input buffers, the hazard async snapshots must be immune to.
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size),
+        ts.batch_sharding(mesh),
+    )
+    state, _ = step_fn(state, {"tokens": tokens, "targets": tokens})
+    ck.wait_until_finished()
+
+    step, restored = ck.restore_latest(
+        target=jax.eval_shape(init_fn, jax.random.PRNGKey(0)),
+    )
+    assert step == 1
+    for a, b in zip(jax.tree.leaves(snapshot), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(a, np.asarray(b))

@@ -77,5 +77,9 @@ def fit(
         if ckptr is not None and (
             done % checkpoint_every == 0 or done == n_steps
         ):
-            ckptr.save(done, state)
+            # Saves overlap with subsequent steps; the trailing wait below
+            # finalizes whichever save is still in flight.
+            ckptr.save(done, state, wait=False)
+    if ckptr is not None:
+        ckptr.wait_until_finished()
     return state, metrics

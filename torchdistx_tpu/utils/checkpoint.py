@@ -78,9 +78,24 @@ class Checkpointer:
             ),
         )
 
-    def save(self, step: int, state: Any) -> None:
+    def save(self, step: int, state: Any, *, wait: bool = True) -> None:
+        """Write a checkpoint for ``step``.
+
+        ``wait=False`` returns as soon as the save is dispatched (orbax
+        persists in the background; device buffers are snapshotted first,
+        so training may mutate/donate the state immediately) — the
+        standard overlap of checkpoint IO with subsequent steps.  Call
+        :meth:`wait_until_finished` before relying on the files: a pending
+        save is NOT finalized by ``restore``/``restore_latest`` (they only
+        see committed steps), only by the next ``save`` or an explicit
+        wait.
+        """
         ocp = _ocp()
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
