@@ -176,3 +176,27 @@ class TestSlowMoTrainStep:
         assert jax.tree.structure(restored) == jax.tree.structure(state)
         for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
             assert np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_zigzag_layout_matches_contiguous(cfg):
+    """Whole-model zigzag layout: same loss as the contiguous layout (the
+    permutation is a relabeling — RoPE uses original positions, targets
+    align), with NO per-layer sequence resharding."""
+    from torchdistx_tpu.models import llama
+
+    tokens_shape = (8, 64)
+    mesh = make_mesh(MeshSpec(fsdp=2, sp=4))
+    init_fn, step_fn = ts.make_train_step(
+        cfg, mesh, optax.sgd(0.1), seq_axis="sp", attn_impl="ring_zigzag",
+        seq_layout="zigzag",
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    batch = _batch(cfg, ts.batch_sharding(mesh), tokens_shape)
+    state, m_z = step_fn(state, batch)
+
+    init_fn, step_fn = ts.make_train_step(
+        cfg, mesh, optax.sgd(0.1), seq_axis="sp", attn_impl="ring"
+    )
+    state = init_fn(jax.random.PRNGKey(0))
+    state, m_c = step_fn(state, batch)
+    assert abs(float(m_z["loss"]) - float(m_c["loss"])) < 1e-3

@@ -286,6 +286,7 @@ def forward(
     attn_impl: str = "auto",
     pp_axis: Optional[str] = None,
     n_microbatches: int = 1,
+    seq_layout: str = "contiguous",
 ):
     """Token ids ``(B, S)`` → logits ``(B, S, V)`` (float32).
 
@@ -296,11 +297,38 @@ def forward(
     GPipe pipeline (:mod:`torchdistx_tpu.parallel.pipeline`) with
     ``n_microbatches`` microbatches (pp composes with tp/fsdp; use jnp or
     pallas attention inside the pipeline, not ring).
+
+    ``seq_layout="zigzag"`` keeps the *whole model's* activations in the
+    zigzag sequence order of the load-balanced causal ring schedule:
+    tokens are permuted once at the embedding, RoPE uses the original
+    per-token positions, every attention call runs the zigzag ring with
+    no per-layer resharding, and the returned logits are in **zigzag
+    order** — use :func:`loss_fn`'s matching ``seq_layout`` (it aligns
+    the targets), or invert with
+    ``parallel.ring_attention._zigzag_perm(s, sp)[1]``.  Requires
+    ``seq_axis`` and no pipeline axis.
     """
     b, s = tokens.shape
+    if seq_layout == "zigzag":
+        if seq_axis is None or mesh is None:
+            raise ValueError("seq_layout='zigzag' needs mesh= and seq_axis=")
+        if pp_axis is not None:
+            raise ValueError("seq_layout='zigzag' does not compose with pp")
+        from ..parallel.ring_attention import _zigzag_perm
+
+        perm, _ = _zigzag_perm(s, mesh.shape[seq_axis])
+        tokens = tokens[:, perm]
+        # RoPE sees each token's ORIGINAL position.
+        positions = jnp.asarray(perm)[None]
+        attn_impl = "ring_zigzag"
+        pre_permuted = True
+    elif seq_layout == "contiguous":
+        # (1, S): broadcasts over any (micro)batch size.
+        positions = jnp.arange(s)[None]
+        pre_permuted = False
+    else:
+        raise ValueError(f"unknown seq_layout: {seq_layout!r}")
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
-    # (1, S): broadcasts over any (micro)batch size.
-    positions = jnp.arange(s)[None]
 
     def block(x, lp):
         bb = x.shape[0]
@@ -311,7 +339,8 @@ def forward(
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         attn = attention(
-            q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
+            q, k, v, causal=True, impl=attn_impl, mesh=mesh,
+            seq_axis=seq_axis, pre_permuted=pre_permuted,
         )
         x = x + attn.reshape(bb, s, -1) @ lp["wo"]
         h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -397,12 +426,23 @@ def loss_fn(
     attn_impl: str = "auto",
     pp_axis: Optional[str] = None,
     n_microbatches: int = 1,
+    seq_layout: str = "contiguous",
 ):
-    """Mean next-token cross-entropy (float32)."""
+    """Mean next-token cross-entropy (float32).
+
+    ``seq_layout="zigzag"``: the forward runs entirely in zigzag sequence
+    order (see :func:`forward`); targets are aligned by the same
+    permutation, and the mean is order-invariant.
+    """
     logits = forward(
         params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl,
-        pp_axis=pp_axis, n_microbatches=n_microbatches,
+        pp_axis=pp_axis, n_microbatches=n_microbatches, seq_layout=seq_layout,
     )
+    if seq_layout == "zigzag":
+        from ..parallel.ring_attention import _zigzag_perm
+
+        perm, _ = _zigzag_perm(tokens.shape[1], mesh.shape[seq_axis])
+        targets = targets[:, perm]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -ll.mean()

@@ -114,6 +114,7 @@ def attention(
     impl: str = "auto",
     mesh=None,
     seq_axis: Optional[str] = None,
+    pre_permuted: bool = False,
 ):
     """Dispatching attention entry point used by the model stack.
 
@@ -146,7 +147,10 @@ def attention(
         return ring_attention(
             q, k, v, mesh=mesh, axis=seq_axis, causal=causal,
             schedule="zigzag" if impl == "ring_zigzag" else "contiguous",
+            pre_permuted=pre_permuted,
         )
+    if pre_permuted:
+        raise ValueError("pre_permuted is only meaningful with ring_zigzag")
     if impl == "pallas":
         from .pallas.flash_attention import flash_attention
 

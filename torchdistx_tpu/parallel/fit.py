@@ -64,22 +64,26 @@ def fit(
     metrics = None
     if start >= n_steps:
         return state, metrics
-    it = iter(batches)
-    for i, batch in enumerate(it):
-        if i >= n_steps:
-            break
-        if i < start:
-            continue  # replay the data stream up to the resume point
-        state, metrics = step_fn(state, batch)
-        done = i + 1
-        if on_metrics is not None:
-            on_metrics(done, metrics)
-        if ckptr is not None and (
-            done % checkpoint_every == 0 or done == n_steps
-        ):
-            # Saves overlap with subsequent steps; the trailing wait below
-            # finalizes whichever save is still in flight.
-            ckptr.save(done, state, wait=False)
-    if ckptr is not None:
-        ckptr.wait_until_finished()
+    try:
+        it = iter(batches)
+        for i, batch in enumerate(it):
+            if i >= n_steps:
+                break
+            if i < start:
+                continue  # replay the data stream up to the resume point
+            state, metrics = step_fn(state, batch)
+            done = i + 1
+            if on_metrics is not None:
+                on_metrics(done, metrics)
+            if ckptr is not None and (
+                done % checkpoint_every == 0 or done == n_steps
+            ):
+                # Saves overlap with subsequent steps; the finally below
+                # finalizes whichever save is still in flight — including
+                # when a later step raises, so every dispatched checkpoint
+                # stays durable for the post-crash resume.
+                ckptr.save(done, state, wait=False)
+    finally:
+        if ckptr is not None:
+            ckptr.wait_until_finished()
     return state, metrics

@@ -237,6 +237,8 @@ def _zigzag_perm(s: int, n: int):
     """
     import numpy as np
 
+    if s % (2 * n):
+        raise ValueError(f"zigzag needs seq {s} divisible by 2·sp={2 * n}")
     h = s // (2 * n)
     order = []
     for i in range(n):
@@ -259,6 +261,7 @@ def ring_attention(
     batch_axes: Sequence[str] = ("dp", "fsdp"),
     head_axes: Sequence[str] = ("tp",),
     schedule: str = "contiguous",
+    pre_permuted: bool = False,
 ):
     """Sequence-parallel attention.  Layout ``(B, S, H, D)`` (global shapes).
 
@@ -276,10 +279,12 @@ def ring_attention(
        (four sequence-global reshards per layer, replayed in backward).
        The balance win pays when per-device attention compute dominates —
        long local sequence, large head count; for short sequences the
-       reshard traffic can exceed the saving.  Keeping activations in
-       zigzag order across the whole model (permuting tokens and position
-       ids once at the embedding and inverting at the loss) removes the
-       per-layer cost; not implemented yet.
+       reshard traffic can exceed the saving.  ``pre_permuted=True`` skips
+       the per-call permutation entirely: the caller keeps the *whole
+       model's* activations in zigzag sequence order (permute tokens and
+       position ids once at the embedding, align the targets at the loss
+       — see ``models.llama.loss_fn(seq_layout="zigzag")``), and outputs
+       stay in zigzag order.
     """
     names = set(mesh.axis_names)
     if axis not in names:
@@ -297,15 +302,19 @@ def ring_attention(
             raise ValueError(
                 f"zigzag needs seq {s} divisible by 2·{axis}={2 * n}"
             )
+        body = functools.partial(_zigzag_ring_body, axis=axis)
+        zz = _shard_map(
+            body, mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
+        if pre_permuted:
+            return zz(q, k, v)
         perm, inv = _zigzag_perm(s, n)
         qz, kz, vz = (jnp.take(x, perm, axis=1) for x in (q, k, v))
-        body = functools.partial(_zigzag_ring_body, axis=axis)
-        out = _shard_map(
-            body, mesh, in_specs=(spec, spec, spec), out_specs=spec
-        )(qz, kz, vz)
-        return jnp.take(out, inv, axis=1)
+        return jnp.take(zz(qz, kz, vz), inv, axis=1)
     if schedule != "contiguous":
         raise ValueError(f"unknown schedule: {schedule!r}")
+    if pre_permuted:
+        raise ValueError("pre_permuted requires schedule='zigzag'")
     body = functools.partial(_ring_body, axis=axis, causal=causal)
     return _shard_map(
         body, mesh, in_specs=(spec, spec, spec), out_specs=spec

@@ -112,6 +112,7 @@ def make_train_step(
     pp_axis: Optional[str] = None,
     n_microbatches: int = 1,
     attn_impl: str = "auto",
+    seq_layout: str = "contiguous",
     loss_fn: Optional[Callable] = None,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for standard optax training.
@@ -142,9 +143,14 @@ def make_train_step(
     specs = model.param_specs(cfg, tp=tp, fsdp=fsdp, **pp_spec_kw)
     abstract = model.abstract_params(cfg)
     param_shardings = fit_shardings(specs, abstract, mesh)
+    # Only forwarded when non-default, so model families without the kwarg
+    # (gpt2/moe) keep working with the base protocol.
+    layout_kw = (
+        {"seq_layout": seq_layout} if seq_layout != "contiguous" else {}
+    )
     _loss = loss_fn or functools.partial(
         model.loss_fn, cfg=cfg, mesh=mesh, seq_axis=seq_axis,
-        attn_impl=attn_impl, **pp_loss_kw,
+        attn_impl=attn_impl, **pp_loss_kw, **layout_kw,
     )
 
     opt_abstract = jax.eval_shape(tx.init, abstract)
