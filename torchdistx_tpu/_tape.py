@@ -282,7 +282,12 @@ def _storage_key(meta: torch.Tensor) -> int:
 
 
 def _mutated_arg_indices(func) -> List[int]:
-    """Positional-arg indices the op writes to, from the schema alias info."""
+    """Schema-arg indices the op writes to, from the schema alias info.
+
+    Indices address ``schema.arguments`` — kwarg-only args (out-variant
+    buffers like ``aminmax.out``'s min/max) get indices past ``len(args)``
+    and are resolved by :func:`arg_at_schema_pos`.
+    """
     out = []
     try:
         schema = func._schema
@@ -292,6 +297,17 @@ def _mutated_arg_indices(func) -> List[int]:
         if arg.alias_info is not None and arg.alias_info.is_write:
             out.append(i)
     return out
+
+
+def arg_at_schema_pos(func, args, kwargs, pos):
+    """The value bound to schema argument ``pos``, positional or kwarg-only."""
+    if pos < len(args):
+        return args[pos]
+    try:
+        name = func._schema.arguments[pos].name
+    except (AttributeError, IndexError):
+        return None
+    return kwargs.get(name)
 
 
 def record_op(
@@ -393,8 +409,9 @@ def record_op(
     # freshly created or aliasing a mutated arg both count as written).
     mutated = set(_mutated_arg_indices(func))
     node.mutated_args = sorted(mutated)
-    for i, a in enumerate(args):
-        if i in mutated and is_fake(a):
+    for i in node.mutated_args:
+        a = arg_at_schema_pos(func, args, kwargs, i)
+        if is_fake(a):
             node.write_storages.append(_storage_key(a._meta))
             node.pinned_storages.append(a._meta.untyped_storage())
     node.write_storages.extend(node.out_storages)

@@ -250,13 +250,17 @@ class _FunctionalReplay:
         outs = out if isinstance(out, (list, tuple)) else [out]
 
         if node.mutated_args:
-            # In-place: scatter the result back through each written
-            # tensor's layout (writes are visible through every alias).
+            # In-place: scatter each mutated arg's OWN result back through
+            # that tensor's layout (writes are visible through every alias).
+            # The arg→output pairing comes from the schema alias sets; a
+            # blanket outs[0] would corrupt the second buffer of a
+            # two-mutation op such as aminmax.out.
+            out_of = _mutation_output_map(op.func, node.mutated_args, len(outs))
             for pos in node.mutated_args:
-                if pos < len(op.args) and isinstance(op.args[pos], OutputRef):
-                    ref = op.args[pos]
+                ref = _tape.arg_at_schema_pos(op.func, op.args, op.kwargs, pos)
+                if isinstance(ref, OutputRef):
                     meta = ref.node.out_metas[ref.index]
-                    self.write(_MetaWindow(meta), outs[0])
+                    self.write(_MetaWindow(meta), outs[out_of[pos]])
         # Fresh outputs define their storages.
         for i, meta in enumerate(node.out_metas):
             if meta is None or i >= len(outs):
@@ -286,6 +290,46 @@ class _LowerCtx:
 def _packet_name(func) -> str:
     # e.g. "aten.uniform_.default"
     return str(func)
+
+
+def _mutation_output_map(func, mutated_args, n_outs) -> dict:
+    """Map each mutated positional arg to the lowering-output index that
+    carries its new value.
+
+    Ground truth is the schema's alias-set pairing: an argument annotated
+    ``Tensor(a!)`` is returned by the output annotated ``Tensor(a!)``
+    (e.g. ``aminmax.out``'s min/max pair).  Ops whose single mutated arg has
+    no aliased return (pure in-place like ``uniform_`` lowered to return the
+    new buffer) fall back to output 0; multiple mutated args without a
+    schema pairing are refused rather than silently corrupted.
+    """
+    mapping: dict = {}
+    schema = getattr(func, "_schema", None)
+    if schema is not None:
+        for pos in mutated_args:
+            if pos >= len(schema.arguments):
+                continue
+            ainfo = schema.arguments[pos].alias_info
+            if ainfo is None:
+                continue
+            aset = set(ainfo.before_set)
+            for j, ret in enumerate(schema.returns):
+                rinfo = ret.alias_info
+                if rinfo is not None and aset & set(rinfo.before_set):
+                    if j < n_outs:
+                        mapping[pos] = j
+                    break
+    missing = [p for p in mutated_args if p not in mapping]
+    if missing:
+        if len(mutated_args) == 1 and n_outs >= 1:
+            mapping[mutated_args[0]] = 0
+        else:
+            raise UnsupportedOpError(
+                f"Cannot pair mutated args {missing} of '{func}' with "
+                f"their outputs ({n_outs} returned): the schema has no "
+                "aliased return for them and more than one arg is mutated."
+            )
+    return mapping
 
 
 def _strip_factory_kwargs(kwargs: dict) -> dict:

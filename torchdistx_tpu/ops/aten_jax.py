@@ -292,6 +292,18 @@ def _clamp_max(ctx, x, max, **kwargs):
     return _jnp().clip(x, None, max)
 
 
+@lowering("aten.aminmax.default", "aten.aminmax.out")
+def _aminmax(ctx, x, *, dim=None, keepdim=False, **kwargs):
+    # out-variant: the min/max buffers arrive in kwargs; the replay engine
+    # scatters each return into its own schema-aliased buffer.
+    jnp = _jnp()
+    axis = None if dim is None else dim
+    return (
+        jnp.amin(x, axis=axis, keepdims=keepdim),
+        jnp.amax(x, axis=axis, keepdims=keepdim),
+    )
+
+
 @lowering("aten.copy_.default")
 def _copy_(ctx, dst, src, non_blocking=False, **kwargs):
     jnp = _jnp()
