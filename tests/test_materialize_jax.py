@@ -378,3 +378,22 @@ def test_out_variant_kwarg_only_mutation():
     np.testing.assert_allclose(
         np.asarray(materialize_tensor_jax(mx)), [2.0, 5.0]
     )
+
+
+def test_exec_cache_is_lru():
+    """A hit refreshes recency, so hot entries survive eviction (ADVICE r2)."""
+    import torchdistx_tpu.materialize as M
+
+    saved = dict(M._EXEC_CACHE)
+    M._EXEC_CACHE.clear()
+    try:
+        M._exec_cache_put("hot", "H")
+        for i in range(M._EXEC_CACHE_MAX - 1):
+            M._exec_cache_put(f"cold{i}", i)
+        assert M._exec_cache_get("hot") == "H"  # refresh: back of the queue
+        M._exec_cache_put("new", "N")           # evicts cold0, not hot
+        assert "hot" in M._EXEC_CACHE
+        assert "cold0" not in M._EXEC_CACHE
+    finally:
+        M._EXEC_CACHE.clear()
+        M._EXEC_CACHE.update(saved)

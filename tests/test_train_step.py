@@ -200,3 +200,29 @@ def test_zigzag_layout_matches_contiguous(cfg):
     state = init_fn(jax.random.PRNGKey(0))
     state, m_c = step_fn(state, batch)
     assert abs(float(m_z["loss"]) - float(m_c["loss"])) < 1e-3
+
+
+def test_zigzag_with_custom_loss_fn_rejected(cfg):
+    """seq_layout cannot be applied to a user loss_fn — must raise, not
+    silently train contiguous (ADVICE r2)."""
+    mesh = make_mesh(MeshSpec(sp=8))
+    with pytest.raises(ValueError, match="custom"):
+        ts.make_train_step(
+            cfg, mesh, optax.sgd(0.1), seq_axis="sp",
+            seq_layout="zigzag", loss_fn=lambda p, t, y: jnp.float32(0),
+        )
+
+
+def test_zigzag_layout_rejects_incompatible_attn_impl(cfg):
+    """Explicit attn_impl='jnp' under seq_layout='zigzag' would attend in
+    permuted order — must raise, not silently override (ADVICE r2)."""
+    mesh = make_mesh(MeshSpec(sp=8))
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size
+    )
+    with pytest.raises(ValueError, match="incompatible"):
+        llama.forward(
+            params, tokens, cfg, mesh=mesh, seq_axis="sp",
+            seq_layout="zigzag", attn_impl="jnp",
+        )

@@ -49,6 +49,7 @@ parameter, not the model).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import torch
@@ -622,6 +623,7 @@ def _plan_groups(
 
 _EXEC_CACHE: "Dict[tuple, Any]" = {}
 _EXEC_CACHE_MAX = 16
+_EXEC_CACHE_LOCK = threading.Lock()
 exec_cache_hits = 0  # introspection for tests/benchmarks
 
 
@@ -635,18 +637,25 @@ def _exec_cache_get(key):
     global exec_cache_hits
     if not _exec_cache_enabled():
         return None
-    fn = _EXEC_CACHE.get(key)
-    if fn is not None:
-        exec_cache_hits += 1
+    with _EXEC_CACHE_LOCK:
+        fn = _EXEC_CACHE.get(key)
+        if fn is not None:
+            exec_cache_hits += 1
+            # LRU refresh: eviction pops the front, so a hit must move the
+            # key to the back or a hot architecture can be evicted over
+            # cold ones.
+            del _EXEC_CACHE[key]
+            _EXEC_CACHE[key] = fn
     return fn
 
 
 def _exec_cache_put(key, fn) -> None:
     if not _exec_cache_enabled():
         return
-    if len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
-        _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
-    _EXEC_CACHE[key] = fn
+    with _EXEC_CACHE_LOCK:
+        if key not in _EXEC_CACHE and len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
+            _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
+        _EXEC_CACHE[key] = fn
 
 
 def materialize_module_jax(

@@ -320,6 +320,13 @@ def forward(
         tokens = tokens[:, perm]
         # RoPE sees each token's ORIGINAL position.
         positions = jnp.asarray(perm)[None]
+        if attn_impl not in ("auto", "ring_zigzag"):
+            # Zigzag-ordered activations are only meaningful to the zigzag
+            # ring schedule; any other kernel would attend in permuted order.
+            raise ValueError(
+                f"attn_impl={attn_impl!r} is incompatible with "
+                "seq_layout='zigzag' (requires 'auto' or 'ring_zigzag')"
+            )
         attn_impl = "ring_zigzag"
         pre_permuted = True
     elif seq_layout == "contiguous":

@@ -112,12 +112,14 @@ def _slice_granules(devices) -> list:
     granule.  Granule order is the sorted key order, so every process
     builds the identical mesh.
     """
-    keys = []
-    for d in devices:
-        key = getattr(d, "slice_index", None)
-        if key is None:
-            key = getattr(d, "process_index", 0)
-        keys.append(key)
+    # All-or-nothing key domain (mirrors make_hybrid_mesh): mixing
+    # slice_index with process_index fallbacks would interleave unrelated
+    # id spaces in the sorted granule order.
+    slice_keys = [getattr(d, "slice_index", None) for d in devices]
+    if all(k is not None for k in slice_keys):
+        keys = slice_keys
+    else:
+        keys = [getattr(d, "process_index", 0) for d in devices]
     granules: dict = {}
     for key, d in zip(keys, devices):
         granules.setdefault(key, []).append(d)

@@ -148,6 +148,16 @@ def make_train_step(
     layout_kw = (
         {"seq_layout": seq_layout} if seq_layout != "contiguous" else {}
     )
+    if loss_fn is not None and seq_layout != "contiguous":
+        # The layout is applied inside the model's own loss_fn (token
+        # permutation + target alignment); it cannot be injected into a
+        # user-provided loss, so silently ignoring it would train on a
+        # contiguous layout the caller did not ask for.
+        raise ValueError(
+            f"seq_layout={seq_layout!r} cannot be combined with a custom "
+            "loss_fn — apply the layout inside your loss_fn and pass "
+            "seq_layout='contiguous'."
+        )
     _loss = loss_fn or functools.partial(
         model.loss_fn, cfg=cfg, mesh=mesh, seq_axis=seq_axis,
         attn_impl=attn_impl, **pp_loss_kw, **layout_kw,

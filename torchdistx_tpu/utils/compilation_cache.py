@@ -23,6 +23,9 @@ import threading
 
 _lock = threading.Lock()
 _done = False
+# cache_everything refcount state (guarded by _lock).
+_ce_depth = 0
+_ce_saved: list = []
 
 
 def ensure_compilation_cache() -> None:
@@ -67,6 +70,14 @@ class cache_everything:
     jit and every multi-hundred-MB train-step executable the *user*
     compiles; scoping keeps the aggressive admission local to
     materialization.
+
+    The thresholds are process-global jax.config state, so the save/restore
+    is refcounted under the module lock: overlapping regions (concurrent
+    materializations) share the OUTERMOST save and restore once, instead of
+    racing each other into a corrupted restore.  Compiles issued by
+    unrelated threads while any region is open are still admitted under the
+    aggressive thresholds — inherent to global config, harmless (extra cache
+    entries).
     """
 
     _FLAGS = (
@@ -75,23 +86,42 @@ class cache_everything:
     )
 
     def __enter__(self):
-        self._saved = []
-        try:
-            import jax
+        global _ce_depth, _ce_saved
+        with _lock:
+            _ce_depth += 1
+            if _ce_depth == 1:
+                _ce_saved = []
+                try:
+                    import jax
 
-            for name, value in self._FLAGS:
-                self._saved.append((name, getattr(jax.config, name)))
-                jax.config.update(name, value)
-        except Exception:
-            self._saved = []
+                    for name, value in self._FLAGS:
+                        _ce_saved.append((name, getattr(jax.config, name)))
+                        jax.config.update(name, value)
+                except Exception:
+                    # Partial failure (e.g. a flag renamed in a newer jax):
+                    # roll back what WAS applied rather than leaving the
+                    # aggressive thresholds process-global.
+                    try:
+                        import jax
+
+                        for name, value in _ce_saved:
+                            jax.config.update(name, value)
+                    except Exception:
+                        pass
+                    _ce_saved = []
         return self
 
     def __exit__(self, *exc):
-        try:
-            import jax
+        global _ce_depth, _ce_saved
+        with _lock:
+            _ce_depth -= 1
+            if _ce_depth == 0:
+                try:
+                    import jax
 
-            for name, value in self._saved:
-                jax.config.update(name, value)
-        except Exception:
-            pass
+                    for name, value in _ce_saved:
+                        jax.config.update(name, value)
+                except Exception:
+                    pass
+                _ce_saved = []
         return False
