@@ -220,3 +220,148 @@ class TestDispatcher:
         q, k, v = _qkv(s=16)
         with pytest.raises(ValueError, match="mesh"):
             attention(q, k, v, impl="ring")
+
+
+class TestShardedFlash:
+    """shard_map-wrapped Pallas kernel under the mesh (VERDICT r2 item 1)."""
+
+    @pytest.mark.parametrize("spec", [
+        MeshSpec(dp=2, fsdp=2, tp=2),
+        MeshSpec(fsdp=8),
+        MeshSpec(tp=2, dp=4),
+    ])
+    def test_values_match_reference(self, spec):
+        from torchdistx_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        mesh = make_mesh(spec)
+        q, k, v = _qkv(b=8, s=64, hq=4, hkv=2)
+        ref = mha_reference(q, k, v, causal=True)
+        out = flash_attention_sharded(
+            q, k, v, causal=True, mesh=mesh, interpret=True
+        )
+        assert jnp.allclose(ref, out, atol=1e-5)
+
+    def test_grads_match_reference(self):
+        from torchdistx_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
+        q, k, v = _qkv(b=4, s=32, hq=4, hkv=4)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+        g_ref = jax.grad(
+            loss(lambda q, k, v: mha_reference(q, k, v, causal=True)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_fa = jax.grad(
+            loss(lambda q, k, v: flash_attention_sharded(
+                q, k, v, causal=True, mesh=mesh, interpret=True
+            )),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_ref, g_fa):
+            assert jnp.allclose(a, b, atol=1e-4)
+
+    def test_inside_jit_with_sharded_inputs(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from torchdistx_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        mesh = make_mesh(MeshSpec(dp=2, tp=2, fsdp=2))
+        q, k, v = _qkv(b=4, s=32, hq=8, hkv=8)
+        sh = NamedSharding(mesh, P(("dp", "fsdp"), None, "tp", None))
+        q, k, v = (jax.device_put(t, sh) for t in (q, k, v))
+        out = jax.jit(
+            lambda q, k, v: flash_attention_sharded(
+                q, k, v, causal=True, mesh=mesh, interpret=True
+            )
+        )(q, k, v)
+        ref = mha_reference(q, k, v, causal=True)
+        assert jnp.allclose(ref, out, atol=1e-5)
+        assert out.sharding.is_equivalent_to(sh, 4)
+
+    def test_shardable_predicate(self):
+        from torchdistx_tpu.ops.pallas.flash_attention import shardable
+
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        assert shardable(mesh, (8, 64, 4, 16), (8, 64, 2, 16))
+        # batch 3 not divisible by dp*fsdp=4
+        assert not shardable(mesh, (3, 64, 4, 16), (3, 64, 2, 16))
+        # kv heads 1 not divisible by tp=2
+        assert not shardable(mesh, (8, 64, 4, 16), (8, 64, 1, 16))
+
+    def test_indivisible_raises(self):
+        from torchdistx_tpu.ops.pallas.flash_attention import (
+            flash_attention_sharded,
+        )
+
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        q, k, v = _qkv(b=3, s=32)
+        with pytest.raises(ValueError, match="not.*divisible|divisible"):
+            flash_attention_sharded(q, k, v, mesh=mesh, interpret=True)
+
+
+class TestAutoSelection:
+    def test_auto_under_mesh_on_tpu_picks_pallas(self, monkeypatch):
+        from torchdistx_tpu.ops import attention as A
+
+        monkeypatch.setattr(A, "_on_tpu", lambda: True)
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        assert A._select_impl(
+            "auto", mesh, None, (8, 64, 4, 16), (8, 64, 2, 16)
+        ) == "pallas"
+        # indivisible shapes fall back to jnp
+        assert A._select_impl(
+            "auto", mesh, None, (3, 64, 4, 16), (3, 64, 2, 16)
+        ) == "jnp"
+        # seq parallelism still wins
+        assert A._select_impl(
+            "auto", mesh, "sp", (8, 64, 4, 16), (8, 64, 2, 16)
+        ) == "ring"
+        assert A._select_impl(
+            "auto", None, None, (8, 64, 4, 16), (8, 64, 2, 16)
+        ) == "pallas"
+
+    def test_pp_forward_pins_jnp(self):
+        from torchdistx_tpu.models import llama
+
+        cfg = llama.llama_test()
+        mesh = make_mesh(MeshSpec(pp=2, dp=4))
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size
+        )
+        with pytest.raises(ValueError, match="pipeline stage"):
+            llama.forward(
+                params, tokens, cfg, mesh=mesh, pp_axis="pp",
+                n_microbatches=2, attn_impl="pallas",
+            )
+
+    def test_auto_under_unknown_axis_names_is_jnp(self, monkeypatch):
+        """A mesh with custom axis names ("data"/"model") must fall back to
+        jnp — the wrapper only understands dp/fsdp/tp (review r3)."""
+        from torchdistx_tpu.ops import attention as A
+
+        monkeypatch.setattr(A, "_on_tpu", lambda: True)
+        mesh = make_mesh(axis_names=("data", "model"), shape=(4, 2))
+        assert A._select_impl(
+            "auto", mesh, None, (8, 64, 4, 16), (8, 64, 2, 16)
+        ) == "jnp"
+
+    def test_slowmo_refuses_explicit_pallas(self):
+        import optax
+        from torchdistx_tpu.models import llama
+        from torchdistx_tpu.parallel import train_step as ts
+        from torchdistx_tpu.parallel.slowmo import SlowMomentumOptimizer
+
+        cfg = llama.llama_test()
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=4))
+        opt = SlowMomentumOptimizer(optax.sgd(0.1), base_lr=0.1, slowmo_freq=2)
+        with pytest.raises(ValueError, match="SlowMo"):
+            ts.make_slowmo_train_step(cfg, mesh, opt, attn_impl="pallas")

@@ -185,6 +185,10 @@ def forward(
 ):
     """Token ids ``(B, S)`` → logits ``(B, S, V)`` (f32, tied embeddings)."""
     b, s = tokens.shape
+    if pp_axis is not None:
+        from ..ops.attention import resolve_stage_attn_impl
+
+        attn_impl = resolve_stage_attn_impl(attn_impl)
     x = jnp.take(params["wte"]["weight"], tokens, axis=0).astype(cfg.dtype)
     x = x + params["wpe"]["weight"][:s].astype(cfg.dtype)[None]
 

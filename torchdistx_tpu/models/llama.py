@@ -335,6 +335,10 @@ def forward(
         pre_permuted = False
     else:
         raise ValueError(f"unknown seq_layout: {seq_layout!r}")
+    if pp_axis is not None:
+        from ..ops.attention import resolve_stage_attn_impl
+
+        attn_impl = resolve_stage_attn_impl(attn_impl)
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
 
     def block(x, lp):

@@ -225,6 +225,10 @@ def forward(
     per-microbatch aux losses).
     """
     b, s = tokens.shape
+    if pp_axis is not None:
+        from ..ops.attention import resolve_stage_attn_impl
+
+        attn_impl = resolve_stage_attn_impl(attn_impl)
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
     positions = jnp.arange(s)[None]
 

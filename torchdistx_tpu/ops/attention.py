@@ -120,25 +120,18 @@ def attention(
 
     ``impl``: ``"auto" | "jnp" | "pallas" | "ring" | "ring_zigzag"``.
     ``auto`` = ring iff ``seq_axis`` is set (sequence/context parallelism);
-    else pallas on TPU when ``mesh`` is None (single-chip); else jnp
-    (XLA-fused, partitions correctly under a mesh).  ``ring_zigzag`` is the
-    load-balanced causal ring schedule (see
-    :mod:`torchdistx_tpu.parallel.ring_attention`).
+    else the Pallas flash kernel on TPU — single-chip directly, under a
+    mesh via its shard_map wrapper (batch over dp/fsdp, heads over tp; see
+    :func:`~torchdistx_tpu.ops.pallas.flash_attention.flash_attention_sharded`)
+    whenever the shapes divide over the mesh; else jnp (XLA-fused,
+    partitions anywhere).  ``ring_zigzag`` is the load-balanced causal ring
+    schedule (see :mod:`torchdistx_tpu.parallel.ring_attention`).
+
+    Callers already *inside* a shard_map (the pipeline stage body) must not
+    select ``"pallas"`` with a mesh — the model forwards pin ``"jnp"``
+    under ``pp_axis``.
     """
-    if impl == "auto":
-        if seq_axis is not None:
-            impl = "ring"
-        elif mesh is None and _on_tpu():
-            # Only auto-select the Pallas kernel outside a mesh: a Mosaic
-            # pallas_call carries no SPMD partitioning rules, so inside a
-            # sharded jit program it would fail to partition (or silently
-            # replicate full attention per chip).  Under a mesh, XLA's fused
-            # jnp path partitions correctly; pass impl="pallas" explicitly to
-            # opt in (e.g. single-axis data parallelism where heads/batch are
-            # replicated per chip).
-            impl = "pallas"
-        else:
-            impl = "jnp"
+    impl = _select_impl(impl, mesh, seq_axis, q.shape, k.shape)
     if impl in ("ring", "ring_zigzag"):
         from ..parallel.ring_attention import ring_attention
 
@@ -152,8 +145,17 @@ def attention(
     if pre_permuted:
         raise ValueError("pre_permuted is only meaningful with ring_zigzag")
     if impl == "pallas":
-        from .pallas.flash_attention import flash_attention
+        from .pallas.flash_attention import (
+            flash_attention,
+            flash_attention_sharded,
+            shardable,
+        )
 
+        if mesh is not None and shardable(mesh, q.shape, k.shape):
+            return flash_attention_sharded(q, k, v, causal=causal, mesh=mesh)
+        # mesh=None, or an explicit "pallas" opt-in whose shapes don't divide
+        # over the mesh: the bare kernel (replicated per chip under a mesh —
+        # the long-documented escape hatch for replicated heads/batch).
         return flash_attention(q, k, v, causal=causal)
     if impl != "jnp":
         raise ValueError(
@@ -161,3 +163,51 @@ def attention(
             "(expected auto|jnp|pallas|ring|ring_zigzag)"
         )
     return mha_reference(q, k, v, causal=causal)
+
+
+# Mesh axes the shard_map wrapper understands: dp/fsdp shard batch, tp
+# shards heads, and activations are replicated over ep/pp at the point
+# attention runs (expert dispatch and pipeline staging have their own
+# shard_maps elsewhere).  A mesh with any OTHER nontrivial axis (custom
+# names like "data"/"model") falls back to jnp — a bare Mosaic call can't
+# partition over axes we don't recognize.
+_KNOWN_AXES = frozenset({"dp", "fsdp", "tp", "ep", "pp"})
+
+
+def _select_impl(impl, mesh, seq_axis, q_shape, kv_shape) -> str:
+    """Resolve ``impl="auto"`` (factored out for direct testing)."""
+    if impl != "auto":
+        return impl
+    if seq_axis is not None:
+        return "ring"
+    if not _on_tpu():
+        return "jnp"
+    if mesh is None:
+        return "pallas"
+    if any(
+        size > 1 and name not in _KNOWN_AXES
+        for name, size in mesh.shape.items()
+    ):
+        return "jnp"
+    from .pallas.flash_attention import shardable
+
+    # Under a mesh the kernel runs through its shard_map wrapper; shapes
+    # that don't divide over the mesh (odd batch vs dp, GQA heads vs tp)
+    # fall back to XLA's fused jnp path, which partitions anything.
+    return "pallas" if shardable(mesh, q_shape, kv_shape) else "jnp"
+
+
+def resolve_stage_attn_impl(attn_impl: str) -> str:
+    """Pin the attention impl for code already inside a pipeline stage.
+
+    Stage bodies run inside the pipeline's shard_map; the flash kernel's
+    own shard_map wrapper cannot nest there, so ``"auto"`` pins to
+    ``"jnp"`` and an explicit ``"pallas"`` is refused.  Shared by every
+    model family's ``forward`` (llama/gpt2/moe).
+    """
+    if attn_impl == "pallas":
+        raise ValueError(
+            "attn_impl='pallas' cannot run inside a pipeline stage; "
+            "use 'auto' or 'jnp'"
+        )
+    return "jnp" if attn_impl == "auto" else attn_impl

@@ -254,12 +254,22 @@ def make_slowmo_train_step(
         params=stacked_shardings, opt_state=opt_shardings, step=_named(mesh, P())
     )
 
+    # The per-replica loss runs under vmap over the stacked dp axis, where
+    # neither the flash kernel's shard_map wrapper (its dp batch spec would
+    # split a replica's local batch across the axis replicas diverge over)
+    # nor the bare Mosaic kernel (no SPMD rules) can run — pin "auto" to
+    # XLA's jnp attention and refuse an explicit "pallas".
+    if attn_impl == "pallas":
+        raise ValueError(
+            "attn_impl='pallas' is not supported in the SlowMo step (the "
+            "loss is vmapped over stacked replicas); use 'auto' or 'jnp'"
+        )
+    resolved_impl = "jnp" if attn_impl == "auto" else attn_impl
+
     def _loss(params, tokens, targets):
-        # mesh is forwarded so attention()'s auto-dispatch knows it is inside
-        # a sharded program (a Mosaic pallas_call has no SPMD partitioning
-        # rules and must not be auto-selected under a mesh).
+        # mesh is forwarded for ring/seq-parallel dispatch decisions.
         return model.loss_fn(
-            params, tokens, targets, cfg, mesh=mesh, attn_impl=attn_impl
+            params, tokens, targets, cfg, mesh=mesh, attn_impl=resolved_impl
         )
 
     @functools.partial(jax.jit, out_shardings=state_shardings)
