@@ -365,3 +365,21 @@ class TestAutoSelection:
         opt = SlowMomentumOptimizer(optax.sgd(0.1), base_lr=0.1, slowmo_freq=2)
         with pytest.raises(ValueError, match="SlowMo"):
             ts.make_slowmo_train_step(cfg, mesh, opt, attn_impl="pallas")
+
+
+def test_noncausal_padded_grads_finite():
+    """Non-causal + padded seq + very negative logits: padded kv cols'
+    p = exp(-lse) must not overflow into NaN dq (review r3)."""
+    b, s, h, d = 1, 100, 2, 16
+    key = jax.random.PRNGKey(0)
+    q = 50.0 * jax.random.normal(key, (b, s, h, d))
+    k = -50.0 * q[:, :, :, :]  # strongly negative logits
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, d))
+    g = jax.grad(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=False, interpret=True
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for arr in g:
+        assert bool(jnp.isfinite(arr).all())
