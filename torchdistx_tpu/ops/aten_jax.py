@@ -140,22 +140,59 @@ def _scalar_tensor(ctx, value, **kwargs):
 
 # ---------------------------------------------------------------------------
 # RNG ops (in-place on torch, pure here).
+#
+# The in-place fills (`uniform_`, `normal_`) draw into a FLAT buffer padded
+# to the next power-of-two length ("bucket") and keep the first ``numel``
+# values.  Two reasons:
+#
+# * shape-diverse models (a resnet has ~25 unique conv shapes) collapse onto
+#   ~log₂(max numel) distinct RNG kernel shapes, so XLA compiles a handful of
+#   generators instead of one per shape;
+# * the grouped materializer's fill fast path (materialize.py) draws the same
+#   buckets vmapped over whole parameter *populations* — threefry keys are
+#   vmap-invariant, so the batched draw is bitwise equal to this per-op
+#   replay, keeping materialize_tensor_jax == materialize_module_jax.
+
+
+# Fills above this size draw EXACT lengths: they are excluded from pooling
+# (materialize._plan_fill_bins imports this bound) because large params are
+# few and shape-repeated, so padding would waste RNG compute and transient
+# HBM for no kernel-shape dedup.
+FILL_POOL_MAX = 1 << 20
+
+
+def fill_bucket(numel: int) -> int:
+    """Padded draw length for a fill of ``numel`` elements.
+
+    Power-of-4 steps while small (padding is free, fewer distinct kernel
+    shapes: 128, 512, 2048, 8192, 32768), power-of-2 up to the pooling
+    bound (waste ≤2×), and exact above it (no pooling there — see
+    FILL_POOL_MAX)."""
+    if numel > FILL_POOL_MAX:
+        return numel
+    b = 128
+    while b < numel:
+        b <<= 2 if b < 16384 else 1
+    return b
 
 
 @lowering("aten.uniform_.default")
 def _uniform_(ctx, x, from_=0.0, to=1.0, **kwargs):
     import jax
 
-    return jax.random.uniform(
-        ctx.key, x.shape, dtype=x.dtype, minval=from_, maxval=to
+    flat = jax.random.uniform(
+        ctx.key, (fill_bucket(x.size),), dtype=x.dtype,
+        minval=from_, maxval=to,
     )
+    return flat[: x.size].reshape(x.shape)
 
 
 @lowering("aten.normal_.default")
 def _normal_(ctx, x, mean=0.0, std=1.0, **kwargs):
     import jax
 
-    return jax.random.normal(ctx.key, x.shape, dtype=x.dtype) * std + mean
+    flat = jax.random.normal(ctx.key, (fill_bucket(x.size),), dtype=x.dtype)
+    return (flat * std + mean)[: x.size].reshape(x.shape)
 
 
 @lowering("aten.randn.default")
