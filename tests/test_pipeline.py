@@ -130,3 +130,135 @@ def test_pipeline_train_step():
         state, m = step_fn(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (hand-interleaved backward, O(P) live activations)
+
+
+def test_1f1b_grads_match_unpipelined():
+    import dataclasses
+
+    from torchdistx_tpu.parallel import pipeline
+
+    cfg = dataclasses.replace(llama.llama_test(), n_layers=4)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    B, S, M, P = 8, 32, 8, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+
+    ref_loss, ref_grads = jax.value_and_grad(llama.loss_fn)(
+        params, tokens, targets, cfg
+    )
+    mesh = make_mesh(MeshSpec(fsdp=2, pp=P))
+    loss, grads = jax.jit(
+        lambda p, t, g: llama.pp_value_and_grad(
+            p, t, g, cfg, mesh=mesh, pp_axis="pp", n_microbatches=M
+        )
+    )(params, tokens, targets)
+    assert jnp.allclose(loss, ref_loss, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: None
+        if jnp.allclose(a, b, atol=2e-5)
+        else pytest.fail("grad mismatch"),
+        ref_grads,
+        grads,
+    )
+    # The memory contract: ring buffer holds 3P/2+1 microbatch activations —
+    # strictly fewer than the M + P - 1 tick-saves GPipe autodiff keeps
+    # live at M = 2P.
+    assert pipeline.last_stash_slots == 3 * P // 2 + 1
+    assert pipeline.last_stash_slots < M + P - 1
+
+
+def test_1f1b_train_step_matches_gpipe():
+    import dataclasses
+
+    cfg = dataclasses.replace(llama.llama_test(), n_layers=4)
+    mesh = make_mesh(axis_names=("tp", "pp"), shape=(2, 4))
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size),
+        ts.batch_sharding(mesh),
+    )
+    batch = {"tokens": tokens, "targets": tokens}
+
+    def run(schedule):
+        init_fn, step_fn = ts.make_train_step(
+            cfg, mesh, optax.sgd(0.1), pp_axis="pp", n_microbatches=8,
+            pp_schedule=schedule, attn_impl="jnp",
+        )
+        state = init_fn(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(3):
+            state, m = step_fn(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    gpipe = run("gpipe")
+    onefb = run("1f1b")
+    # Same optimization trajectory (same grads up to accumulation order).
+    for a, b in zip(gpipe, onefb):
+        assert abs(a - b) < 2e-3, (gpipe, onefb)
+    assert onefb[-1] < onefb[0]
+
+
+def test_1f1b_wallclock_not_worse_than_gpipe():
+    """At M = 2P with rematerialized blocks, 1F1B's tick count (2M + 2P - 3)
+    carries the same total compute as GPipe's forward+transpose — assert
+    compiled wall-clock parity within generous slack (CPU timing)."""
+    import dataclasses
+    import time
+
+    cfg = dataclasses.replace(
+        llama.llama_test(), n_layers=4, dim=128, ffn_dim=256, remat=True
+    )
+    mesh = make_mesh(
+        axis_names=("pp",), shape=(4,), devices=jax.devices()[:4]
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size)
+    batch = {"tokens": tokens, "targets": tokens}
+
+    def timed(schedule):
+        init_fn, step_fn = ts.make_train_step(
+            cfg, mesh, optax.sgd(0.1), pp_axis="pp", n_microbatches=8,
+            pp_schedule=schedule, attn_impl="jnp",
+        )
+        state = init_fn(jax.random.PRNGKey(0))
+        state, m = step_fn(state, batch)  # compile
+        float(m["loss"])
+        best = float("inf")
+        for _ in range(3):  # best-of-3: shield against scheduler stalls
+            t0 = time.perf_counter()
+            for _ in range(5):
+                state, m = step_fn(state, batch)
+            float(m["loss"])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_gpipe = timed("gpipe")
+    t_1f1b = timed("1f1b")
+    # CPU lockstep timing is noisy even best-of-3 (asymmetric CI load
+    # between the two phases); 1.75 slack still catches the failure mode
+    # that matters — the ~2x wall of a serialized fwd/bwd schedule.
+    assert t_1f1b <= 1.75 * t_gpipe, (t_1f1b, t_gpipe)
+
+
+def test_1f1b_rejects_custom_loss_and_unsupported_model():
+    import dataclasses
+
+    from torchdistx_tpu.models import moe
+
+    cfg = dataclasses.replace(llama.llama_test(), n_layers=4)
+    mesh = make_mesh(
+        axis_names=("pp",), shape=(4,), devices=jax.devices()[:4]
+    )
+    with pytest.raises(ValueError, match="custom loss_fn"):
+        ts.make_train_step(
+            cfg, mesh, optax.sgd(0.1), pp_axis="pp", pp_schedule="1f1b",
+            loss_fn=lambda p, t, g: 0.0,
+        )
+    with pytest.raises(ValueError, match="pp_value_and_grad"):
+        ts.make_train_step(
+            moe.moe_test(), mesh, optax.sgd(0.1), pp_axis="pp",
+            pp_schedule="1f1b", model=moe,
+        )

@@ -51,6 +51,8 @@ __all__ = [
     "forward",
     "loss_fn",
     "num_params",
+    "pp_pieces",
+    "pp_value_and_grad",
 ]
 
 
@@ -276,6 +278,43 @@ def _rope(x, positions, theta):
     return out
 
 
+def _build_block(
+    cfg: LlamaConfig,
+    *,
+    positions=None,
+    mesh=None,
+    seq_axis=None,
+    attn_impl="auto",
+    pre_permuted=False,
+):
+    """One transformer block as ``block(x, lp) -> x`` over unstacked layer
+    params — shared by :func:`forward` and the 1F1B pipeline pieces.
+    ``positions=None`` derives contiguous positions from the input shape."""
+
+    def block(x, lp):
+        bb, s = x.shape[0], x.shape[1]
+        pos = (
+            jnp.arange(s)[None] if positions is None else positions
+        )
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(bb, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        attn = attention(
+            q, k, v, causal=True, impl=attn_impl, mesh=mesh,
+            seq_axis=seq_axis, pre_permuted=pre_permuted,
+        )
+        x = x + attn.reshape(bb, s, -1) @ lp["wo"]
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+        x = x + gated @ lp["w_down"]
+        return x
+
+    return block
+
+
 def forward(
     params,
     tokens,
@@ -341,24 +380,10 @@ def forward(
         attn_impl = resolve_stage_attn_impl(attn_impl)
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
 
-    def block(x, lp):
-        bb = x.shape[0]
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(bb, s, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        attn = attention(
-            q, k, v, causal=True, impl=attn_impl, mesh=mesh,
-            seq_axis=seq_axis, pre_permuted=pre_permuted,
-        )
-        x = x + attn.reshape(bb, s, -1) @ lp["wo"]
-        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
-        x = x + gated @ lp["w_down"]
-        return x
-
+    block = _build_block(
+        cfg, positions=positions, mesh=mesh, seq_axis=seq_axis,
+        attn_impl=attn_impl, pre_permuted=pre_permuted,
+    )
     body = jax.checkpoint(block) if cfg.remat else block
     if pp_axis is not None:
         from ..parallel.pipeline import pipeline_forward
@@ -457,3 +482,74 @@ def loss_fn(
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -ll.mean()
+
+
+# ---------------------------------------------------------------------------
+# 1F1B pipeline pieces (see parallel.pipeline.pipeline_value_and_grad):
+# embedding on stage 0, blocks pipelined, loss head inside the last stage.
+
+
+def pp_pieces(cfg: LlamaConfig, *, mesh=None, attn_impl: str = "auto"):
+    """``(embed_fn, block_fn, head_loss_fn)`` for the 1F1B schedule."""
+    from ..ops.attention import resolve_stage_attn_impl
+
+    impl = resolve_stage_attn_impl(attn_impl)
+    block = _build_block(cfg, mesh=mesh, attn_impl=impl)
+    body = jax.checkpoint(block) if cfg.remat else block
+
+    def embed_fn(ep, tokens_mb):
+        return jnp.take(
+            ep["embed"]["weight"], tokens_mb, axis=0
+        ).astype(cfg.dtype)
+
+    def head_loss_fn(hp, h, targets_mb):
+        x = _rmsnorm(h, hp["norm"]["weight"], cfg.norm_eps)
+        logits = (x @ hp["lm_head"]["weight"].astype(cfg.dtype)).astype(
+            jnp.float32
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets_mb[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+    return embed_fn, body, head_loss_fn
+
+
+def pp_value_and_grad(
+    params,
+    tokens,
+    targets,
+    cfg: LlamaConfig,
+    *,
+    mesh,
+    pp_axis: str = "pp",
+    n_microbatches: int = 1,
+    attn_impl: str = "auto",
+):
+    """``(loss, grads)`` via the 1F1B pipeline — a drop-in replacement for
+    ``jax.value_and_grad(loss_fn)`` when training pipeline-parallel, with
+    O(P) live activations instead of O(M + P) (GPipe autodiff)."""
+    from ..parallel.pipeline import pipeline_value_and_grad
+
+    embed_fn, block_fn, head_loss_fn = pp_pieces(
+        cfg, mesh=mesh, attn_impl=attn_impl
+    )
+    loss, (g_ep, g_lp, g_hp) = pipeline_value_and_grad(
+        {"embed": params["embed"]},
+        params["layers"],
+        {"norm": params["norm"], "lm_head": params["lm_head"]},
+        tokens,
+        targets,
+        embed_fn,
+        block_fn,
+        head_loss_fn,
+        mesh=mesh,
+        axis=pp_axis,
+        n_microbatches=n_microbatches,
+    )
+    grads = {
+        "embed": g_ep["embed"],
+        "layers": g_lp,
+        "norm": g_hp["norm"],
+        "lm_head": g_hp["lm_head"],
+    }
+    return loss, grads

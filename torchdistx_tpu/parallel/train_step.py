@@ -111,6 +111,7 @@ def make_train_step(
     seq_axis: Optional[str] = None,
     pp_axis: Optional[str] = None,
     n_microbatches: int = 1,
+    pp_schedule: str = "gpipe",
     attn_impl: str = "auto",
     seq_layout: str = "contiguous",
     loss_fn: Optional[Callable] = None,
@@ -130,6 +131,11 @@ def make_train_step(
     ``step_fn(state, batch) -> (state, metrics)`` — one jitted SPMD training
     step; ``batch`` is ``{"tokens": (B,S), "targets": (B,S)}`` sharded with
     :func:`batch_sharding`.  State buffers are donated.
+
+    ``pp_schedule``: ``"gpipe"`` (autodiff through the pipeline scan) or
+    ``"1f1b"`` (hand-written interleaved backward with O(P) live
+    activations — :func:`parallel.pipeline.pipeline_value_and_grad`;
+    requires a model family exposing ``pp_value_and_grad``, e.g. llama).
     """
     # pp kwargs are only passed when pipeline parallelism is requested, so
     # custom model families implementing the base protocol
@@ -163,6 +169,35 @@ def make_train_step(
         attn_impl=attn_impl, **pp_loss_kw, **layout_kw,
     )
 
+    if pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp_schedule: {pp_schedule!r}")
+    value_and_grad = None
+    if pp_schedule == "1f1b":
+        if pp_axis is None:
+            raise ValueError("pp_schedule='1f1b' requires pp_axis=")
+        if loss_fn is not None:
+            raise ValueError(
+                "pp_schedule='1f1b' computes the loss inside the pipeline "
+                "and cannot wrap a custom loss_fn"
+            )
+        if seq_axis is not None or seq_layout != "contiguous":
+            # pp_pieces has no sequence-parallel path; silently training on
+            # a contiguous layout would diverge from the same call under
+            # pp_schedule='gpipe'.
+            raise ValueError(
+                "pp_schedule='1f1b' does not compose with seq_axis/"
+                "seq_layout — use pp_schedule='gpipe' for sp×pp"
+            )
+        if not hasattr(model, "pp_value_and_grad"):
+            raise ValueError(
+                f"pp_schedule='1f1b' requires {model.__name__} to expose "
+                "pp_value_and_grad (see models.llama)"
+            )
+        value_and_grad = functools.partial(
+            model.pp_value_and_grad, cfg=cfg, mesh=mesh, pp_axis=pp_axis,
+            n_microbatches=n_microbatches, attn_impl=attn_impl,
+        )
+
     opt_abstract = jax.eval_shape(tx.init, abstract)
     opt_shardings = _match_param_shardings(
         mesh, abstract, param_shardings, opt_abstract
@@ -186,9 +221,14 @@ def make_train_step(
         jax.jit, out_shardings=(state_shardings, None), donate_argnums=(0,)
     )
     def step_fn(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(_loss)(
-            state.params, batch["tokens"], batch["targets"]
-        )
+        if value_and_grad is not None:
+            loss, grads = value_and_grad(
+                state.params, batch["tokens"], batch["targets"]
+            )
+        else:
+            loss, grads = jax.value_and_grad(_loss)(
+                state.params, batch["tokens"], batch["targets"]
+            )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         import optax
 
