@@ -17,8 +17,8 @@ mkdir -p "$LIBDIR"
 g++ -std=c++17 -O1 -g -fPIC -shared $SAN \
   -o "$LIBDIR/libtdx_core.so" src/cc/tdx_core/graph.cc
 PY_INCLUDE=$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])")
-g++ -std=c++17 -O1 -g -fPIC -shared $SAN -I"$PY_INCLUDE" \
-  -o "$LIBDIR/_tdx_stack.so" src/cc/tdx_core/stack.cc
+g++ -std=c++17 -O1 -g -fPIC -shared $SAN -I"$PY_INCLUDE" -Isrc/cc/tdx_core \
+  -o "$LIBDIR/_tdx_stack.so" src/cc/tdx_core/stack.cc src/cc/tdx_core/graph.cc
 
 # Touch the libs so the loaders' staleness check doesn't rebuild over the
 # sanitized artifacts.
@@ -36,6 +36,6 @@ python -m pytest tests/test_native_tape.py tests/test_fake.py \
 # Rebuild un-sanitized so later local runs aren't preloaded-dependent.
 g++ -std=c++17 -O2 -fPIC -shared \
   -o "$LIBDIR/libtdx_core.so" src/cc/tdx_core/graph.cc
-g++ -std=c++17 -O2 -fPIC -shared -I"$PY_INCLUDE" \
-  -o "$LIBDIR/_tdx_stack.so" src/cc/tdx_core/stack.cc
+g++ -std=c++17 -O2 -fPIC -shared -I"$PY_INCLUDE" -Isrc/cc/tdx_core \
+  -o "$LIBDIR/_tdx_stack.so" src/cc/tdx_core/stack.cc src/cc/tdx_core/graph.cc
 echo "sanitizer lane: OK"

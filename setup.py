@@ -38,9 +38,10 @@ class build_py_with_native(build_py):
         include = sysconfig.get_paths()["include"]
         subprocess.run(
             common
-            + [f"-I{include}",
+            + [f"-I{include}", f"-I{CC_DIR}",
                "-o", os.path.join(lib_dir, "_tdx_stack.so"),
-               os.path.join(CC_DIR, "stack.cc")],
+               os.path.join(CC_DIR, "stack.cc"),
+               os.path.join(CC_DIR, "graph.cc")],
             check=True,
         )
 

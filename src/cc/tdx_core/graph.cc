@@ -47,20 +47,55 @@ int tdx_graph_add_dep(tdx_graph* g, int64_t op_nr, int64_t producer_op_nr) {
 }
 
 int tdx_graph_note_write(tdx_graph* g, int64_t op_nr, uint64_t storage_key) {
+  return tdx_graph_note_write_prev(g, op_nr, storage_key, nullptr, 0) < 0 ? -1
+                                                                          : 0;
+}
+
+int64_t tdx_graph_note_write_prev(tdx_graph* g, int64_t op_nr,
+                                  uint64_t storage_key, int64_t* out_prev,
+                                  int64_t cap) {
   auto it = g->nodes.find(op_nr);
   if (it == g->nodes.end()) return -1;
   auto& entries = g->writers[storage_key];
+  int64_t n_prev = 0;
   for (int64_t prev_nr : entries) {
     if (prev_nr == op_nr) continue;
     auto prev = g->nodes.find(prev_nr);
-    if (prev != g->nodes.end()) prev->second.dependents.push_back(op_nr);
+    if (prev != g->nodes.end()) {
+      prev->second.dependents.push_back(op_nr);
+      if (n_prev < cap) out_prev[n_prev] = prev_nr;
+      n_prev++;
+    }
   }
   entries.push_back(op_nr);
-  return 0;
+  return n_prev;
 }
 
 int64_t tdx_graph_num_nodes(const tdx_graph* g) {
   return static_cast<int64_t>(g->nodes.size());
+}
+
+int tdx_graph_has_node(const tdx_graph* g, int64_t op_nr) {
+  return g->nodes.count(op_nr) ? 1 : 0;
+}
+
+int64_t tdx_graph_writer_keys(const tdx_graph* g, uint64_t* out, int64_t cap) {
+  int64_t n = 0;
+  for (const auto& [key, entries] : g->writers) {
+    (void)entries;
+    if (n < cap) out[n] = key;
+    n++;
+  }
+  return n;
+}
+
+int64_t tdx_graph_writers_of(const tdx_graph* g, uint64_t storage_key,
+                             int64_t* out, int64_t cap) {
+  auto it = g->writers.find(storage_key);
+  if (it == g->writers.end()) return 0;
+  int64_t n = static_cast<int64_t>(it->second.size());
+  for (int64_t i = 0; i < std::min(n, cap); ++i) out[i] = it->second[i];
+  return n;
 }
 
 int64_t tdx_graph_call_stack(const tdx_graph* g, int64_t target_op_nr,

@@ -46,8 +46,27 @@ TDX_API int tdx_graph_add_dep(tdx_graph* g, int64_t op_nr,
 TDX_API int tdx_graph_note_write(tdx_graph* g, int64_t op_nr,
                                  uint64_t storage_key);
 
+// Like tdx_graph_note_write, additionally writing the op_nrs of the
+// PREVIOUS writers/touchers of the storage (the nodes that just received a
+// dependent back-edge) into `out_prev` (up to `cap`).  Returns the previous
+// writer count, or -1 if the node is unknown.  The Python binding uses this
+// to mirror the back-edges into the OpNodes' keep-alive `dependents` lists.
+TDX_API int64_t tdx_graph_note_write_prev(tdx_graph* g, int64_t op_nr,
+                                          uint64_t storage_key,
+                                          int64_t* out_prev, int64_t cap);
+
 // Queries -------------------------------------------------------------------
 TDX_API int64_t tdx_graph_num_nodes(const tdx_graph* g);
+
+TDX_API int tdx_graph_has_node(const tdx_graph* g, int64_t op_nr);
+
+// Writer-index export (for downgrading a native tape to the Python path):
+// the distinct storage keys, and each key's writer op_nrs in record order.
+// Same cap/count convention as tdx_graph_call_stack.
+TDX_API int64_t tdx_graph_writer_keys(const tdx_graph* g, uint64_t* out,
+                                      int64_t cap);
+TDX_API int64_t tdx_graph_writers_of(const tdx_graph* g, uint64_t storage_key,
+                                     int64_t* out, int64_t cap);
 
 // Materialization call-stack for `target_op_nr` (deferred_init.cc:529-621):
 // horizon = latest dependent writer of the target's storages; closure over

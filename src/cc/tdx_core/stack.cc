@@ -20,11 +20,31 @@
 //                                      immutability validation analog of
 //                                      deferred_init.cc:227-253)
 //
+// Plus the native per-op RECORD core (the deferred_init.cc:102-710 analog's
+// hot half; _tape.py remains the executable spec and the fallback):
+//
+//   OutputRef            — C type for dependency edges (node, index)
+//   Recorder             — per-tape C++ graph: writer index, dep/dependent
+//                          edges, weak node registry, call-stack traversal,
+//                          downgrade-to-Python export
+//   record_preserve(args, kwargs, fake_type, slot_key, guard_type)
+//                        — the whole argument-preservation walk in C:
+//                          fake→OutputRef substitution + dependency
+//                          collection, external-tensor guard snapshots,
+//                          immutable-domain validation
+//
 // Exotic containers (namedtuples, torch.return_types struct sequences, dict
 // subclasses) raise Fallback; callers keep the pytree path for those.
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
 
 namespace {
 
@@ -215,12 +235,511 @@ PyObject* py_convert(PyObject*, PyObject* args) {
   return convert_rec(obj, fn, strict, &changed);
 }
 
+// ---------------------------------------------------------------------------
+// OutputRef: the dependency-edge marker (analog of the reference's
+// OpOutputDescriptor, deferred_init.cc:106-154) as a C type.  Holds the
+// producing node STRONGLY; participates in GC (node→args→OutputRef→node
+// cycles are how tapes die).
+
+typedef struct {
+  PyObject_HEAD
+  PyObject* node;
+  Py_ssize_t index;
+} OutputRefObject;
+
+extern PyTypeObject OutputRefType;
+
+PyObject* outputref_new_fast(PyObject* node, Py_ssize_t index) {
+  OutputRefObject* self =
+      PyObject_GC_New(OutputRefObject, &OutputRefType);
+  if (!self) return nullptr;
+  Py_INCREF(node);
+  self->node = node;
+  self->index = index;
+  PyObject_GC_Track((PyObject*)self);
+  return (PyObject*)self;
+}
+
+PyObject* OutputRef_tp_new(PyTypeObject*, PyObject* args, PyObject* kwds) {
+  PyObject* node;
+  Py_ssize_t index;
+  static const char* kwlist[] = {"node", "index", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "On", (char**)kwlist, &node,
+                                   &index))
+    return nullptr;
+  return outputref_new_fast(node, index);
+}
+
+void OutputRef_dealloc(OutputRefObject* self) {
+  PyObject_GC_UnTrack((PyObject*)self);
+  Py_CLEAR(self->node);
+  PyObject_GC_Del(self);
+}
+
+int OutputRef_traverse(OutputRefObject* self, visitproc visit, void* arg) {
+  Py_VISIT(self->node);
+  return 0;
+}
+
+int OutputRef_clear(OutputRefObject* self) {
+  Py_CLEAR(self->node);
+  return 0;
+}
+
+PyObject* OutputRef_repr(OutputRefObject* self) {
+  PyObject* nr = PyObject_GetAttrString(self->node, "op_nr");
+  if (!nr) return nullptr;
+  PyObject* out = PyUnicode_FromFormat("OutputRef(op_nr=%S, index=%zd)", nr,
+                                       self->index);
+  Py_DECREF(nr);
+  return out;
+}
+
+PyMemberDef OutputRef_members[] = {
+    {"node", Py_T_OBJECT_EX, offsetof(OutputRefObject, node), 0, nullptr},
+    {"index", Py_T_PYSSIZET, offsetof(OutputRefObject, index), 0, nullptr},
+    {nullptr, 0, 0, 0, nullptr},
+};
+
+PyTypeObject OutputRefType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "_tdx_stack.OutputRef",              /* tp_name */
+    sizeof(OutputRefObject),             /* tp_basicsize */
+    0,                                   /* tp_itemsize */
+    (destructor)OutputRef_dealloc,       /* tp_dealloc */
+    0, nullptr, nullptr, nullptr,        /* vectorcall/getattr/setattr/as_async */
+    (reprfunc)OutputRef_repr,            /* tp_repr */
+    nullptr, nullptr, nullptr,           /* number/sequence/mapping */
+    nullptr, nullptr, nullptr,           /* hash/call/str */
+    nullptr, nullptr, nullptr,           /* getattro/setattro/as_buffer */
+    Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC, /* tp_flags */
+    "Dependency edge: (producing node, output index)", /* tp_doc */
+    (traverseproc)OutputRef_traverse,    /* tp_traverse */
+    (inquiry)OutputRef_clear,            /* tp_clear */
+};
+
+// ---------------------------------------------------------------------------
+// Recorder: per-tape native graph.  The graph ENGINE is tdx_graph
+// (graph.cc — one implementation, shared with the C-ABI/ctypes lane and
+// stress-tested under TSan by scripts/tsan_native.sh); this type adds the
+// Python glue: a weak op_nr→OpNode registry (call-stack results are always
+// strongly reachable from the target via the Python OutputRef edges, so a
+// strong registry would pin whole tapes) and the keep-alive `dependents`
+// mirroring.  All mutation runs under the GIL — the same serialization
+// contract the stress harness models with a mutex.
+
+#include "graph.h"
+
+typedef struct {
+  PyObject_HEAD
+  tdx_graph* graph;
+  std::unordered_map<int64_t, PyObject*>* wrefs;  // op_nr -> weakref(OpNode)
+} RecorderObject;
+
+PyObject* Recorder_tp_new(PyTypeObject* type, PyObject*, PyObject*) {
+  RecorderObject* self = (RecorderObject*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  self->graph = tdx_graph_new();
+  self->wrefs = new std::unordered_map<int64_t, PyObject*>();
+  return (PyObject*)self;
+}
+
+void Recorder_dealloc(RecorderObject* self) {
+  if (self->wrefs) {
+    for (auto& [nr, wref] : *self->wrefs) Py_XDECREF(wref);
+    delete self->wrefs;
+  }
+  if (self->graph) tdx_graph_free(self->graph);
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyObject* deref_or_null(PyObject* wref) {
+  PyObject* obj = PyWeakref_GetObject(wref);  // borrowed
+  return (obj == Py_None) ? nullptr : obj;
+}
+
+PyObject* recorder_deref(RecorderObject* self, int64_t nr) {
+  auto it = self->wrefs->find(nr);
+  return it == self->wrefs->end() ? nullptr : deref_or_null(it->second);
+}
+
+// note_op(op_nr, node, dep_nodes, write_keys) -> bool
+// False (no side effects) when a dependency is unknown to this recorder —
+// a cross-tape edge; the caller downgrades the tape to the Python path.
+//
+// Besides the numeric graph, this also appends the new node to each
+// earlier writer's PYTHON `dependents` list, exactly like the Python
+// note_write: those strong refs are the keep-alive contract (a later
+// in-place op on a view stays reachable from the base's producing node)
+// AND what cross-tape Python traversals navigate.
+PyObject* Recorder_note_op(RecorderObject* self, PyObject* args) {
+  long long op_nr;
+  PyObject *node, *dep_nodes, *write_keys;
+  if (!PyArg_ParseTuple(args, "LOOO", &op_nr, &node, &dep_nodes, &write_keys))
+    return nullptr;
+  if (!PyList_Check(dep_nodes) || !PyList_Check(write_keys)) {
+    PyErr_SetString(PyExc_TypeError, "dep_nodes/write_keys must be lists");
+    return nullptr;
+  }
+  std::vector<int64_t> dep_nrs;
+  Py_ssize_t nd = PyList_GET_SIZE(dep_nodes);
+  dep_nrs.reserve(nd);
+  for (Py_ssize_t i = 0; i < nd; i++) {
+    PyObject* nr_obj =
+        PyObject_GetAttrString(PyList_GET_ITEM(dep_nodes, i), "op_nr");
+    if (!nr_obj) return nullptr;
+    long long nr = PyLong_AsLongLong(nr_obj);
+    Py_DECREF(nr_obj);
+    if (nr == -1 && PyErr_Occurred()) return nullptr;
+    if (!tdx_graph_has_node(self->graph, nr))
+      Py_RETURN_FALSE;  // cross-tape dependency
+    dep_nrs.push_back(nr);
+  }
+  PyObject* wref = PyWeakref_NewRef(node, nullptr);
+  if (!wref) return nullptr;
+  tdx_graph_add_node(self->graph, op_nr);
+  (*self->wrefs)[op_nr] = wref;
+  for (int64_t d : dep_nrs) tdx_graph_add_dep(self->graph, op_nr, d);
+  Py_ssize_t nw = PyList_GET_SIZE(write_keys);
+  std::vector<int64_t> prev;
+  for (Py_ssize_t i = 0; i < nw; i++) {
+    uint64_t key =
+        PyLong_AsUnsignedLongLongMask(PyList_GET_ITEM(write_keys, i));
+    if (PyErr_Occurred()) return nullptr;
+    int64_t n =
+        tdx_graph_writers_of(self->graph, key, nullptr, 0);  // pre-note
+    prev.resize((size_t)n);
+    tdx_graph_writers_of(self->graph, key, prev.data(), n);
+    tdx_graph_note_write(self->graph, op_nr, key);
+    for (int64_t p : prev) {
+      if (p == op_nr) continue;
+      PyObject* prev_obj = recorder_deref(self, p);
+      if (!prev_obj) continue;  // dead toucher: same skip as Python
+      PyObject* deplist = PyObject_GetAttrString(prev_obj, "dependents");
+      if (!deplist) return nullptr;
+      int rc = PyList_Append(deplist, node);
+      Py_DECREF(deplist);
+      if (rc < 0) return nullptr;
+    }
+  }
+  Py_RETURN_TRUE;
+}
+
+// call_stack(op_nr) -> [OpNode, ...] chronological — tdx_graph's
+// buildCallStack traversal mapped back to Python nodes.
+PyObject* Recorder_call_stack(RecorderObject* self, PyObject* arg) {
+  long long target = PyLong_AsLongLong(arg);
+  if (target == -1 && PyErr_Occurred()) return nullptr;
+  int64_t cap = tdx_graph_num_nodes(self->graph);
+  std::vector<int64_t> buf((size_t)cap);
+  int64_t n = tdx_graph_call_stack(self->graph, target, buf.data(), cap);
+  if (n < 0) {
+    PyErr_Format(PyExc_KeyError, "unknown op_nr %lld", target);
+    return nullptr;
+  }
+  PyObject* out = PyList_New((Py_ssize_t)n);
+  if (!out) return nullptr;
+  for (int64_t i = 0; i < n; i++) {
+    PyObject* obj = recorder_deref(self, buf[(size_t)i]);
+    if (!obj) {
+      // Unreachable by construction (schedule members are strongly
+      // reachable from the target); fail loudly rather than truncate.
+      Py_DECREF(out);
+      PyErr_Format(PyExc_RuntimeError, "node %lld died",
+                   (long long)buf[(size_t)i]);
+      return nullptr;
+    }
+    Py_INCREF(obj);
+    PyList_SET_ITEM(out, (Py_ssize_t)i, obj);
+  }
+  return out;
+}
+
+// downgrade() -> {storage_key: [OpNode, ...]}: hand the graph back to the
+// Python path (cross-tape dependency appeared).  The Python `dependents`
+// lists were maintained all along (note_op), so only membership needs
+// clearing and the writer index exporting — future Python note_write calls
+// must still see the native-era writers.
+PyObject* Recorder_downgrade(RecorderObject* self, PyObject*) {
+  for (auto& [nr, wref] : *self->wrefs) {
+    PyObject* obj = deref_or_null(wref);
+    if (!obj) continue;
+    if (PyObject_SetAttrString(obj, "native_graph", Py_None) < 0)
+      return nullptr;
+  }
+  int64_t nk = tdx_graph_writer_keys(self->graph, nullptr, 0);
+  std::vector<uint64_t> keys((size_t)nk);
+  tdx_graph_writer_keys(self->graph, keys.data(), nk);
+  PyObject* out = PyDict_New();
+  if (!out) return nullptr;
+  std::vector<int64_t> nrs;
+  for (uint64_t key : keys) {
+    int64_t n = tdx_graph_writers_of(self->graph, key, nullptr, 0);
+    nrs.resize((size_t)n);
+    tdx_graph_writers_of(self->graph, key, nrs.data(), n);
+    PyObject* lst = PyList_New(0);
+    if (!lst) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    for (int64_t nr : nrs) {
+      PyObject* obj = recorder_deref(self, nr);
+      if (obj && PyList_Append(lst, obj) < 0) {
+        Py_DECREF(lst);
+        Py_DECREF(out);
+        return nullptr;
+      }
+    }
+    PyObject* key_obj = PyLong_FromUnsignedLongLong(key);
+    int rc = key_obj ? PyDict_SetItem(out, key_obj, lst) : -1;
+    Py_XDECREF(key_obj);
+    Py_DECREF(lst);
+    if (rc < 0) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+  }
+  return out;
+}
+
+Py_ssize_t Recorder_len(PyObject* self) {
+  return (Py_ssize_t)tdx_graph_num_nodes(((RecorderObject*)self)->graph);
+}
+
+PyMethodDef Recorder_methods[] = {
+    {"note_op", (PyCFunction)Recorder_note_op, METH_VARARGS,
+     "note_op(op_nr, node, dep_nodes, write_keys) -> bool"},
+    {"call_stack", (PyCFunction)Recorder_call_stack, METH_O,
+     "call_stack(op_nr) -> [OpNode, ...]"},
+    {"downgrade", (PyCFunction)Recorder_downgrade, METH_NOARGS,
+     "downgrade() -> {storage_key: [OpNode, ...]}"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PySequenceMethods Recorder_as_sequence = {
+    Recorder_len,  /* sq_length */
+};
+
+PyTypeObject RecorderType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "_tdx_stack.Recorder",               /* tp_name */
+    sizeof(RecorderObject),              /* tp_basicsize */
+    0,                                   /* tp_itemsize */
+    (destructor)Recorder_dealloc,        /* tp_dealloc */
+    0, nullptr, nullptr, nullptr,        /* vectorcall/getattr/setattr/as_async */
+    nullptr,                             /* tp_repr */
+    nullptr, &Recorder_as_sequence, nullptr, /* number/sequence/mapping */
+    nullptr, nullptr, nullptr,           /* hash/call/str */
+    nullptr, nullptr, nullptr,           /* getattro/setattro/as_buffer */
+    Py_TPFLAGS_DEFAULT,                  /* tp_flags */
+    "Per-tape native op graph (writer index + edges + weak registry)",
+    nullptr, nullptr,                    /* tp_traverse/tp_clear */
+};
+
+// ---------------------------------------------------------------------------
+// record_preserve: the argument-preservation walk (copyStack,
+// deferred_init.cc:69-100 + the immutability validation of 227-253) fully
+// in C.  Fake tensors become OutputRef edges (their producing nodes
+// collected as dependencies), real tensors get version-guard snapshots,
+// immutable scalars pass through; anything else raises Fallback and the
+// caller retries with the pytree deep-copy path.
+
+struct PreserveCtx {
+  PyObject* fake_type;
+  PyObject* slot_key;
+  PyObject* guard_type;
+  PyObject* deps;    // list of producing OpNodes
+  PyObject* guards;  // list of ExternalTensorGuard
+};
+
+PyObject* preserve_leaf(PyObject* obj, PreserveCtx* ctx, int* changed) {
+  int is_fake = PyObject_IsInstance(obj, ctx->fake_type);
+  if (is_fake < 0) return nullptr;
+  if (is_fake) {
+    PyObject* slots = PyObject_GetAttrString(obj, "_slots");
+    if (!slots) return nullptr;
+    PyObject* rec = PyDict_GetItemWithError(slots, ctx->slot_key);  // borrowed
+    Py_DECREF(slots);
+    if (!rec) {
+      if (!PyErr_Occurred())
+        PyErr_SetString(
+            PyExc_RuntimeError,
+            "Cannot record an operation on a fake tensor that was created "
+            "outside of a deferred-init context.");
+      return nullptr;
+    }
+    PyObject* node = PyObject_GetAttrString(rec, "node");
+    if (!node) return nullptr;
+    PyObject* index = PyObject_GetAttrString(rec, "index");
+    if (!index) {
+      Py_DECREF(node);
+      return nullptr;
+    }
+    Py_ssize_t idx = PyLong_AsSsize_t(index);
+    Py_DECREF(index);
+    if (idx == -1 && PyErr_Occurred()) {
+      Py_DECREF(node);
+      return nullptr;
+    }
+    int rc = PyList_Append(ctx->deps, node);
+    PyObject* oref = rc < 0 ? nullptr : outputref_new_fast(node, idx);
+    Py_DECREF(node);
+    if (oref) *changed = 1;
+    return oref;
+  }
+  int is_tensor = PyObject_IsInstance(obj, g_tensor_type);
+  if (is_tensor < 0) return nullptr;
+  if (is_tensor) {
+    PyObject* version = PyObject_GetAttrString(obj, "_version");
+    if (!version) return nullptr;
+    PyObject* guard =
+        PyObject_CallFunctionObjArgs(ctx->guard_type, obj, version, nullptr);
+    Py_DECREF(version);
+    if (!guard) return nullptr;
+    int rc = PyList_Append(ctx->guards, guard);
+    Py_DECREF(guard);
+    if (rc < 0) return nullptr;
+    Py_INCREF(obj);
+    return obj;
+  }
+  // The known-immutable leaf domain (deferred_init.cc:227-253).
+  if (!(obj == Py_None || PyBool_Check(obj) || PyLong_CheckExact(obj) ||
+        PyFloat_CheckExact(obj) || PyUnicode_CheckExact(obj) ||
+        PyBytes_CheckExact(obj) || PyComplex_CheckExact(obj))) {
+    int ok = PyObject_IsInstance(obj, g_ok_types);
+    if (ok < 0) return nullptr;
+    if (!ok) {
+      PyErr_SetString(g_fallback, "leaf outside immutable domain");
+      return nullptr;
+    }
+  }
+  Py_INCREF(obj);
+  return obj;
+}
+
+PyObject* preserve_rec(PyObject* obj, PreserveCtx* ctx, int* changed) {
+  if (PyTuple_Check(obj)) {
+    if (!PyTuple_CheckExact(obj)) {
+      PyErr_SetString(g_fallback, "tuple subclass");
+      return nullptr;
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(obj);
+    PyObject* items = PyList_New(n);
+    if (!items) return nullptr;
+    int any = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      int c = 0;
+      PyObject* r = preserve_rec(PyTuple_GET_ITEM(obj, i), ctx, &c);
+      if (!r) {
+        Py_DECREF(items);
+        return nullptr;
+      }
+      any |= c;
+      PyList_SET_ITEM(items, i, r);
+    }
+    if (!any) {
+      Py_DECREF(items);
+      Py_INCREF(obj);
+      return obj;
+    }
+    *changed = 1;
+    PyObject* out = PyList_AsTuple(items);
+    Py_DECREF(items);
+    return out;
+  }
+  if (PyList_Check(obj)) {
+    if (!PyList_CheckExact(obj)) {
+      PyErr_SetString(g_fallback, "list subclass");
+      return nullptr;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(obj);
+    PyObject* out = PyList_New(n);
+    if (!out) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      int c = 0;
+      PyObject* r = preserve_rec(PyList_GET_ITEM(obj, i), ctx, &c);
+      if (!r) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+      *changed |= c;
+      PyList_SET_ITEM(out, i, r);
+    }
+    *changed = 1;  // fresh list either way (arg stacks are never shared)
+    return out;
+  }
+  if (PyDict_Check(obj)) {
+    if (!PyDict_CheckExact(obj)) {
+      PyErr_SetString(g_fallback, "dict subclass");
+      return nullptr;
+    }
+    PyObject* out = PyDict_New();
+    if (!out) return nullptr;
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(obj, &pos, &key, &value)) {
+      int c = 0;
+      PyObject* r = preserve_rec(value, ctx, &c);
+      if (!r) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+      *changed |= c;
+      int rc = PyDict_SetItem(out, key, r);
+      Py_DECREF(r);
+      if (rc < 0) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+    }
+    *changed = 1;
+    return out;
+  }
+  return preserve_leaf(obj, ctx, changed);
+}
+
+PyObject* py_record_preserve(PyObject*, PyObject* args) {
+  PyObject *in_args, *in_kwargs, *fake_type, *slot_key, *guard_type;
+  if (!PyArg_ParseTuple(args, "OOOOO", &in_args, &in_kwargs, &fake_type,
+                        &slot_key, &guard_type))
+    return nullptr;
+  if (!g_tensor_type) {
+    PyErr_SetString(PyExc_RuntimeError, "register_types() not called");
+    return nullptr;
+  }
+  PreserveCtx ctx{fake_type, slot_key, guard_type, PyList_New(0),
+                  PyList_New(0)};
+  if (!ctx.deps || !ctx.guards) {
+    Py_XDECREF(ctx.deps);
+    Py_XDECREF(ctx.guards);
+    return nullptr;
+  }
+  int changed = 0;
+  PyObject* p_args = preserve_rec(in_args, &ctx, &changed);
+  PyObject* p_kwargs = p_args ? preserve_rec(in_kwargs, &ctx, &changed) : nullptr;
+  if (!p_kwargs) {
+    Py_XDECREF(p_args);
+    Py_DECREF(ctx.deps);
+    Py_DECREF(ctx.guards);
+    return nullptr;
+  }
+  PyObject* out = PyTuple_Pack(4, p_args, p_kwargs, ctx.deps, ctx.guards);
+  Py_DECREF(p_args);
+  Py_DECREF(p_kwargs);
+  Py_DECREF(ctx.deps);
+  Py_DECREF(ctx.guards);
+  return out;
+}
+
 PyMethodDef methods[] = {
     {"register_types", py_register_types, METH_VARARGS,
      "register_types(tensor_type, ok_types_tuple)"},
     {"leaves", py_leaves, METH_O, "leaves(obj) -> list"},
     {"convert", py_convert, METH_VARARGS,
      "convert(obj, fn, strict=False) -> mapped obj"},
+    {"record_preserve", py_record_preserve, METH_VARARGS,
+     "record_preserve(args, kwargs, fake_type, slot_key, guard_type) -> "
+     "(p_args, p_kwargs, dep_nodes, guards)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -233,6 +752,12 @@ PyModuleDef moduledef = {
 }  // namespace
 
 PyMODINIT_FUNC PyInit__tdx_stack(void) {
+  OutputRefType.tp_new = OutputRef_tp_new;
+  OutputRefType.tp_members = OutputRef_members;
+  RecorderType.tp_new = Recorder_tp_new;
+  RecorderType.tp_methods = Recorder_methods;
+  if (PyType_Ready(&OutputRefType) < 0 || PyType_Ready(&RecorderType) < 0)
+    return nullptr;
   PyObject* m = PyModule_Create(&moduledef);
   if (!m) return nullptr;
   g_fallback = PyErr_NewException("_tdx_stack.Fallback", nullptr, nullptr);
@@ -242,5 +767,17 @@ PyMODINIT_FUNC PyInit__tdx_stack(void) {
     return nullptr;
   }
   Py_INCREF(g_fallback);  // module owns one ref; keep ours for raising
+  Py_INCREF(&OutputRefType);
+  if (PyModule_AddObject(m, "OutputRef", (PyObject*)&OutputRefType) < 0) {
+    Py_DECREF(&OutputRefType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  Py_INCREF(&RecorderType);
+  if (PyModule_AddObject(m, "Recorder", (PyObject*)&RecorderType) < 0) {
+    Py_DECREF(&RecorderType);
+    Py_DECREF(m);
+    return nullptr;
+  }
   return m;
 }

@@ -141,7 +141,8 @@ def _try_build_stack() -> bool:
         subprocess.run(
             [
                 "g++", "-std=c++17", "-O2", "-fPIC", "-shared",
-                f"-I{include}", "-o", tmp, _STACK_SRC,
+                f"-I{include}", f"-I{os.path.dirname(_SRC)}",
+                "-o", tmp, _STACK_SRC, _SRC,
             ],
             check=True,
             capture_output=True,
@@ -158,8 +159,14 @@ def _try_build_stack() -> bool:
 
 
 def _stale_stack() -> bool:
+    # The extension links the graph engine (graph.cc) in — either source
+    # being newer triggers a rebuild.
     try:
-        return os.path.getmtime(_STACK_SRC) > os.path.getmtime(_STACK_LIB)
+        lib_mtime = os.path.getmtime(_STACK_LIB)
+        return (
+            os.path.getmtime(_STACK_SRC) > lib_mtime
+            or os.path.getmtime(_SRC) > lib_mtime
+        )
     except OSError:
         return True
 

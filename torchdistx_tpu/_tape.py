@@ -52,12 +52,16 @@ _op_counter = itertools.count()
 # materialize.py's RNG note.
 
 
-class OutputRef:
+class _PyOutputRef:
     """Marker replacing a fake-tensor argument inside a recorded arg stack.
 
     Analog of the reference's dependency ``OpOutputDescriptor``
     (deferred_init.cc:106-154): names the producing node + output index, and
     holds the node strongly (keep-alive, like TensorRecord's view refs).
+
+    The native core defines the same type in C (src/cc/tdx_core/stack.cc);
+    ``OutputRef`` below binds to whichever is live so isinstance checks see
+    one class everywhere.
     """
 
     __slots__ = ("node", "index")
@@ -68,6 +72,14 @@ class OutputRef:
 
     def __repr__(self):
         return f"OutputRef(op_nr={self.node.op_nr}, index={self.index})"
+
+
+_stack_mod = _native.stack_ops()
+OutputRef = (
+    _stack_mod.OutputRef
+    if _stack_mod is not None and hasattr(_stack_mod, "OutputRef")
+    else _PyOutputRef
+)
 
 
 @dataclass
@@ -213,25 +225,34 @@ class Tape:
     """
 
     def __init__(self):
-        # storage key -> list of (op_nr, weakref to node) that WROTE it
+        # storage key -> list of (op_nr, weakref to node) that WROTE it.
+        # Maintained only on the Python path: with a native Recorder the
+        # writer index lives in C++ (and is exported on downgrade).
         self.writers: Dict[int, List[Tuple[int, weakref.ref]]] = {}
         self.base_nr: Optional[int] = None  # first recorded op_nr
-        # Native-core mirror of the graph structure (C++ traversals for
-        # call-stack building).  Per-tape: storage keys are raw addresses
-        # whose lifetime is only pinned within a tape, so a process-global
-        # graph could see reused addresses as false aliases.
-        try:
-            self.native_graph = _native.NativeGraph()
-        except RuntimeError:
-            self.native_graph = None
+        # Native recorder: the writer index, dep/dependent edges, and
+        # call-stack traversal in C++ (src/cc/tdx_core/stack.cc Recorder).
+        # Per-tape: storage keys are raw addresses whose lifetime is only
+        # pinned within a tape, so a process-global graph could see reused
+        # addresses as false aliases.
+        s = _native.stack_ops()
+        self.native_graph = (
+            s.Recorder() if s is not None and hasattr(s, "Recorder") else None
+        )
 
     def disable_native(self) -> None:
-        """Drop the native mirror (e.g. a cross-tape dependency appeared —
-        its producer lives in another tape's graph, so this graph's
-        traversals would be incomplete)."""
+        """Hand the graph back to the Python path (e.g. a cross-tape
+        dependency appeared — its producer lives in another tape's graph, so
+        this graph's traversals would be incomplete).  The recorder installs
+        its dependent edges into the Python nodes and exports its writer
+        index so the Python ``note_write`` keeps linking correctly."""
         if self.native_graph is not None:
-            for node in self.native_graph.nodes.values():
-                node.native_graph = None
+            exported = self.native_graph.downgrade()
+            for key, nodes in exported.items():
+                entries = self.writers.setdefault(key, [])
+                entries.extend(
+                    (n.op_nr, weakref.ref(n)) for n in nodes
+                )
             self.native_graph = None
 
     def note_write(self, storage_key: int, node: OpNode) -> None:
@@ -310,16 +331,42 @@ def arg_at_schema_pos(func, args, kwargs, pos):
     return kwargs.get(name)
 
 
+# Per-func cache of (name string, mutated schema-arg indices): schemas are
+# immutable, and str(OpOverload) + the alias_info walk cost ~25ms of a
+# GPT-2-XL record (1743 ops) when recomputed per op.
+_SCHEMA_CACHE: Dict[Any, Tuple[str, Tuple[int, ...]]] = {}
+
+
+def _schema_info(func) -> Tuple[str, Tuple[int, ...]]:
+    info = _SCHEMA_CACHE.get(func)
+    if info is None:
+        info = (str(func), tuple(_mutated_arg_indices(func)))
+        _SCHEMA_CACHE[func] = info
+    return info
+
+
+# Lazily-bound canonical record protocol (deferred_init/fake import this
+# module at their top level, so the reverse imports must wait until first
+# record) — bound once, not per op: record_op is the hot path.
+_PROTO = None
+
+
+def _record_protocol():
+    global _PROTO
+    if _PROTO is None:
+        from .deferred_init import _SLOT
+        from .fake import FakeTensor, _convert_tensors, _StrictFallback
+
+        _PROTO = (_SLOT, FakeTensor, _convert_tensors, _StrictFallback)
+    return _PROTO
+
+
 def record_op(
     tape: Tape,
     func,
     args: tuple,
     kwargs: dict,
     fake_outputs: list,
-    *,
-    is_fake: Callable[[Any], bool],
-    get_record: Callable[[Any], Optional[TensorRecord]],
-    set_record: Callable[[Any, TensorRecord], None],
 ) -> OpNode:
     """Record one op — analog of ``recordOp`` (deferred_init.cc:673-710).
 
@@ -329,13 +376,24 @@ def record_op(
     same way attachDependencies does (deferred_init.cc:463-495).  Real
     tensors are kept with version guards; all other leaves are deep-copied
     (copyStack, deferred_init.cc:69-100).
+
+    Hot path: argument preservation, the writer index, and dependency/
+    dependent bookkeeping run in the native core
+    (src/cc/tdx_core/stack.cc: ``record_preserve`` + ``Recorder.note_op``);
+    the Python implementation below is the executable spec and the fallback
+    (``TDX_DISABLE_NATIVE=1``, exotic containers, cross-tape edges).
     """
+    _SLOT, FakeTensor, _convert_tensors, _StrictFallback = _record_protocol()
+
+    def is_fake(a):
+        return isinstance(a, FakeTensor)
+
     guards: List[ExternalTensorGuard] = []
     dep_nodes: List[OpNode] = []
 
     def preserve(a):
         if is_fake(a):
-            rec = get_record(a)
+            rec = a._slots.get(_SLOT)
             if rec is None:
                 raise RuntimeError(
                     "Cannot record an operation on a fake tensor that was "
@@ -361,28 +419,40 @@ def record_op(
                 f"{type(a).__name__} is not preservable."
             ) from e
 
-    # Native fast path: C container recursion with `preserve` applied only
-    # to tensor leaves, validating everything else against the immutable
-    # domain (deferred_init.cc:227-253); full-domain pytree walk (which also
-    # deep-copies unknown preservable leaves) when validation signals out.
-    from .fake import _convert_tensors, _StrictFallback
-
-    try:
-        p_args, p_kwargs = _convert_tensors(
-            (tuple(args), dict(kwargs)), preserve, strict=True
-        )
-    except _StrictFallback:
-        # The aborted native walk already ran `preserve` on earlier tensor
-        # leaves; drop those side effects before the full retry or every
-        # external guard / dependency edge would be recorded twice.
+    # Native fast path: the whole preserve walk (container recursion, fake→
+    # OutputRef substitution, guard snapshots, immutable-domain validation)
+    # in C; full-domain pytree walk (which also deep-copies unknown
+    # preservable leaves) when validation signals out.
+    s = _stack_mod
+    p_args = None
+    if s is not None and hasattr(s, "record_preserve"):
+        try:
+            p_args, p_kwargs, dep_nodes, guards = s.record_preserve(
+                tuple(args), dict(kwargs), FakeTensor, _SLOT,
+                ExternalTensorGuard,
+            )
+        except s.Fallback:
+            p_args = None
+    if p_args is None:
         guards.clear()
         dep_nodes.clear()
-        p_args, p_kwargs = pytree.tree_map(
-            preserve, (tuple(args), dict(kwargs))
-        )
+        try:
+            p_args, p_kwargs = _convert_tensors(
+                (tuple(args), dict(kwargs)), preserve, strict=True
+            )
+        except _StrictFallback:
+            # The aborted native walk already ran `preserve` on earlier
+            # tensor leaves; drop those side effects before the full retry
+            # or every guard / dependency edge would be recorded twice.
+            guards.clear()
+            dep_nodes.clear()
+            p_args, p_kwargs = pytree.tree_map(
+                preserve, (tuple(args), dict(kwargs))
+            )
 
+    name, mutated = _schema_info(func)
     op = Op(
-        name=str(func),
+        name=name,
         func=func,
         args=p_args,
         kwargs=p_kwargs,
@@ -407,37 +477,35 @@ def record_op(
 
     # Storages the op WROTE: schema-mutated args + all outputs (an output
     # freshly created or aliasing a mutated arg both count as written).
-    mutated = set(_mutated_arg_indices(func))
-    node.mutated_args = sorted(mutated)
+    node.mutated_args = list(mutated)
     for i in node.mutated_args:
         a = arg_at_schema_pos(func, args, kwargs, i)
         if is_fake(a):
             node.write_storages.append(_storage_key(a._meta))
             node.pinned_storages.append(a._meta.untyped_storage())
     node.write_storages.extend(node.out_storages)
-    for key in set(node.write_storages):
-        tape.note_write(key, node)
+    write_keys = list(set(node.write_storages))
 
-    # Mirror the structure into the native core (C++ call-stack builder).
+    # Writer index + dependent edges: native recorder when live (one C call
+    # per op; cross-tape deps signal False with no side effects), Python
+    # otherwise.
     g = tape.native_graph
     if g is not None:
-        deps = dep_nodes
-        if any(d.native_graph is not g for d in deps):
+        if g.note_op(node.op_nr, node, dep_nodes, write_keys):
+            node.native_graph = g
+        else:
             # Cross-tape dependency: the producer lives in another tape's
             # graph, so this graph's traversals would be incomplete.
             tape.disable_native()
-        else:
-            g.add_node(node.op_nr, node)
-            node.native_graph = g
-            for d in deps:
-                g.add_dep(node.op_nr, d.op_nr)
-            for key in set(node.write_storages):
-                g.note_write(node.op_nr, key)
+            g = None
+    if g is None:
+        for key in write_keys:
+            tape.note_write(key, node)
 
     # Point each fake output's record at this node (deferred_init.cc:683-710).
     for idx, out in enumerate(fake_outputs):
         if out is not None:
-            set_record(out, TensorRecord(node, idx))
+            out._slots[_SLOT] = TensorRecord(node, idx)
     return node
 
 
@@ -457,7 +525,7 @@ def build_call_stack(target: OpNode) -> List[OpNode]:
     """
     g = target.native_graph
     if g is not None:
-        return [g.nodes[nr] for nr in g.call_stack(target.op_nr)]
+        return g.call_stack(target.op_nr)
     horizon = target.op_nr
     for d in target.dependents:
         if d.op_nr > horizon:

@@ -70,10 +70,6 @@ def _get_record(fake: FakeTensor) -> Optional[TensorRecord]:
     return fake._slots.get(_SLOT)
 
 
-def _set_record(fake: FakeTensor, record: TensorRecord) -> None:
-    fake._slots[_SLOT] = record
-
-
 def is_deferred(tensor: torch.Tensor) -> bool:
     """True if ``tensor`` is fake and carries a deferred-init record."""
     return isinstance(tensor, FakeTensor) and _get_record(tensor) is not None
@@ -113,16 +109,7 @@ class _DeferredInitMode(TorchDispatchMode):
         has_fake_arg = any(isinstance(a, FakeTensor) for a in flat_in)
         if has_fake_arg or fake_outputs:
             # Record iff a fake flows in or out (deferred_init.cc:780-796).
-            _tape.record_op(
-                self.tape,
-                func,
-                args,
-                kwargs,
-                fake_outputs,
-                is_fake=lambda a: isinstance(a, FakeTensor),
-                get_record=_get_record,
-                set_record=_set_record,
-            )
+            _tape.record_op(self.tape, func, args, kwargs, fake_outputs)
         return out
 
 

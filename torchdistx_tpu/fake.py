@@ -186,17 +186,8 @@ class FakeTensor(torch.Tensor):
                     new.shape, new.stride(), dtype=new.dtype, device="meta"
                 )
             lifted = FakeTensor(meta, self.fake_device)
-            from .deferred_init import _SLOT, _get_record as _gr  # noqa: F401
-
             _tape.record_op(
-                tape,
-                torch.ops.aten.clone.default,
-                (new,),
-                {},
-                [lifted],
-                is_fake=lambda a: isinstance(a, FakeTensor),
-                get_record=lambda a: a._slots.get(_SLOT),
-                set_record=lambda a, r: a._slots.__setitem__(_SLOT, r),
+                tape, torch.ops.aten.clone.default, (new,), {}, [lifted]
             )
             new = lifted
         # Swap the impl (shape/dtype may change — set_data semantics), then
