@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# ThreadSanitizer lane over the native core — the reference's tsan-wheel CI
+# analog (/root/reference/cmake/Helpers.cmake:287-316,
+# .github/workflows/_test_wheel.yaml:49-89).
+#
+# Python would flood TSan with interpreter-internal reports, so this lane
+# drives tdx_core directly (src/cc/tdx_core/graph_stress.cc) under the same
+# threading contract the bindings provide: mutations serialized (the GIL's
+# role, played by a mutex), traversals concurrent.  See that file's header.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUILD=build/tsan
+mkdir -p "$BUILD"
+
+g++ -std=c++17 -O1 -g -fno-omit-frame-pointer -fsanitize=thread \
+  -Isrc/cc/tdx_core \
+  -o "$BUILD/graph_stress" \
+  src/cc/tdx_core/graph.cc src/cc/tdx_core/graph_stress.cc \
+  -lpthread
+
+TSAN_OPTIONS=halt_on_error=1 "$BUILD/graph_stress"
+echo "tsan lane: OK"

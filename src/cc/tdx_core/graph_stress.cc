@@ -1,0 +1,104 @@
+// TSan stress harness for the native graph (scripts/tsan_native.sh).
+//
+// The reference ships tsan wheels through CI (/root/reference/cmake/
+// Helpers.cmake:287-316, .github/workflows/_test_wheel.yaml:49-89).  Here
+// the Python interpreter would drown TSan in interpreter-internal reports,
+// so the lane drives the C++ core directly under the SAME threading
+// contract the bindings provide:
+//
+//  * graph mutation (add_node/add_dep/note_write) is serialized — in-process
+//    that's the GIL; here an explicit mutex plays its role;
+//  * call-stack traversals may run CONCURRENTLY from many threads once
+//    recording has quiesced (materialize from worker threads), and also
+//    interleave with serialized mutations of a DIFFERENT tape's graph.
+//
+// Any data race visible under this contract is a real bug in tdx_core.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "graph.h"
+
+namespace {
+
+// Build a chain-with-aliasing tape: node i depends on i-1, every 8th node
+// rewrites storage (i % 4) so dependents edges exist.
+tdx_graph* build_graph(int n) {
+  tdx_graph* g = tdx_graph_new();
+  for (int i = 0; i < n; i++) {
+    assert(tdx_graph_add_node(g, i) == 0);
+    if (i > 0) assert(tdx_graph_add_dep(g, i, i - 1) == 0);
+    assert(tdx_graph_note_write(g, i, 0x1000 + (i % 4)) == 0);
+  }
+  return g;
+}
+
+}  // namespace
+
+int main() {
+  constexpr int kNodes = 512;
+  constexpr int kThreads = 8;
+  constexpr int kIters = 200;
+
+  // Phase 1: concurrent read-only traversals over a finished tape.
+  tdx_graph* frozen = build_graph(kNodes);
+  std::vector<std::thread> readers;
+  std::vector<int64_t> sums(kThreads, 0);
+  for (int t = 0; t < kThreads; t++) {
+    readers.emplace_back([&, t] {
+      std::vector<int64_t> buf(kNodes);
+      for (int it = 0; it < kIters; it++) {
+        int64_t target = (t * 37 + it * 11) % kNodes;
+        int64_t n = tdx_graph_call_stack(frozen, target, buf.data(),
+                                         (int64_t)buf.size());
+        assert(n > 0 && n <= kNodes);
+        for (int64_t i = 0; i < n; i++) sums[t] += buf[i];
+      }
+    });
+  }
+
+  // Phase 2 (concurrently): a second tape being recorded under the
+  // serialization lock while the readers above traverse the frozen one.
+  tdx_graph* live = tdx_graph_new();
+  std::mutex gil;
+  std::vector<std::thread> writers;
+  for (int t = 0; t < 4; t++) {
+    writers.emplace_back([&, t] {
+      for (int i = 0; i < kNodes / 4; i++) {
+        std::lock_guard<std::mutex> lock(gil);
+        int64_t nr = (int64_t)t * 1000 + i;
+        tdx_graph_add_node(live, nr);
+        tdx_graph_note_write(live, nr, 0x2000 + (nr % 8));
+      }
+    });
+  }
+  for (auto& th : writers) th.join();
+  for (auto& th : readers) th.join();
+
+  // Phase 3: readers over the now-quiesced second tape.
+  {
+    std::vector<std::thread> post;
+    for (int t = 0; t < kThreads; t++) {
+      post.emplace_back([&, t] {
+        std::vector<int64_t> buf(kNodes);
+        for (int it = 0; it < kIters; it++) {
+          int64_t n = tdx_graph_call_stack(live, (int64_t)(t % 4) * 1000,
+                                           buf.data(), (int64_t)buf.size());
+          assert(n > 0);
+        }
+      });
+    }
+    for (auto& th : post) th.join();
+  }
+
+  int64_t total = 0;
+  for (int64_t s : sums) total += s;
+  std::printf("graph_stress: OK (checksum %lld)\n", (long long)total);
+  tdx_graph_free(frozen);
+  tdx_graph_free(live);
+  return 0;
+}
