@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Docs gate — the analog of the reference's built-and-checked Sphinx site.
+
+The docs are plain markdown by design; this gate keeps them honest:
+
+* every relative link / image in docs/*.md and README.md resolves;
+* every `path/file.py`, `src/...`, `scripts/...` code reference in the docs
+  points at a file that exists (docstrings cite the reference tree, which
+  isn't shipped — "reference `...`" citations are exempt).
+
+Run: python scripts/check_docs.py   (CI runs it in the docs job).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DOCS = sorted((ROOT / "docs").glob("*.md")) + [ROOT / "README.md"]
+
+LINK = re.compile(r"\[[^\]]*\]\(([^)#\s]+)(?:#[^)]*)?\)")
+# `some/path.py` or `some/path.cc` style code refs (repo-relative).
+PATHREF = re.compile(
+    r"`((?:torchdistx_tpu|src|scripts|tests|docs|packaging)/[\w./-]+?"
+    r"\.(?:py|cc|h|md|sh|yaml|toml))`"
+)
+
+errors: list[str] = []
+
+for doc in DOCS:
+    text = doc.read_text()
+    for m in LINK.finditer(text):
+        target = m.group(1)
+        if re.match(r"^[a-z]+://", target) or target.startswith("mailto:"):
+            continue  # external: not checked (zero-egress CI lanes)
+        resolved = (doc.parent / target).resolve()
+        if not resolved.exists():
+            errors.append(f"{doc.relative_to(ROOT)}: broken link {target!r}")
+    for m in PATHREF.finditer(text):
+        # Citations into the (unshipped) reference tree are exempt —
+        # they're provenance, marked "reference `...`" in prose.
+        if text[max(0, m.start() - 32):m.start()].rstrip().endswith(
+            "reference"
+        ):
+            continue
+        ref = ROOT / m.group(1)
+        if not ref.exists():
+            errors.append(
+                f"{doc.relative_to(ROOT)}: dangling code ref {m.group(1)!r}"
+            )
+
+if errors:
+    print("\n".join(errors))
+    sys.exit(1)
+print(f"docs gate: OK ({len(DOCS)} pages)")

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# C++ unit tests for the native core (src/cc/tdx_core/graph_test.cc) — the
+# tests/cc dir the reference left as a TODO.  Run plain and under
+# ASan+UBSan.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUILD=build/cctest
+mkdir -p "$BUILD"
+
+g++ -std=c++17 -O1 -g -Isrc/cc/tdx_core -o "$BUILD/graph_test" \
+  src/cc/tdx_core/graph.cc src/cc/tdx_core/graph_test.cc
+"$BUILD/graph_test"
+
+g++ -std=c++17 -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer \
+  -Isrc/cc/tdx_core -o "$BUILD/graph_test_asan" \
+  src/cc/tdx_core/graph.cc src/cc/tdx_core/graph_test.cc
+ASAN_OPTIONS=abort_on_error=1 UBSAN_OPTIONS=halt_on_error=1 \
+  "$BUILD/graph_test_asan"
+
+echo "native unit tests: OK"

@@ -14,6 +14,9 @@
 //
 // Any data race visible under this contract is a real bug in tdx_core.
 
+// Asserts carry the graph construction AND the oracles — a Release/-DNDEBUG
+// build would silently delete both and "pass" vacuously.
+#undef NDEBUG
 #include <cassert>
 #include <cstdint>
 #include <cstdio>

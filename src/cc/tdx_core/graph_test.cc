@@ -1,0 +1,122 @@
+// C++ unit tests for the tdx_graph engine — the tests/cc the reference
+// left as a TODO (its root CMakeLists.txt:104-106: "#TODO: Add catch2
+// tests"; tests/cc holds only a .gitkeep).  Plain asserts, no framework:
+// run by scripts/native_tests.sh and the CI native lanes.
+//
+// Python-level parity of these semantics is separately asserted against
+// the pure-Python executable spec in tests/test_native_tape.py.
+
+#undef NDEBUG
+#include <cassert>
+#include <cstdio>
+#include <vector>
+
+#include "graph.h"
+
+namespace {
+
+std::vector<int64_t> call_stack(tdx_graph* g, int64_t target) {
+  int64_t cap = tdx_graph_num_nodes(g);
+  std::vector<int64_t> buf((size_t)cap);
+  int64_t n = tdx_graph_call_stack(g, target, buf.data(), cap);
+  assert(n >= 0);
+  buf.resize((size_t)n);
+  return buf;
+}
+
+void test_dependency_closure() {
+  tdx_graph* g = tdx_graph_new();
+  for (int64_t i = 0; i < 4; i++) assert(tdx_graph_add_node(g, i) == 0);
+  assert(tdx_graph_add_dep(g, 1, 0) == 0);
+  assert(tdx_graph_add_dep(g, 2, 1) == 0);
+  // 3 independent.
+  assert(call_stack(g, 2) == (std::vector<int64_t>{0, 1, 2}));
+  assert(call_stack(g, 3) == (std::vector<int64_t>{3}));
+  tdx_graph_free(g);
+}
+
+void test_inplace_horizon() {
+  // t produced by 0; in-place writes at 2 and 5 (each depending on t, as a
+  // recorded in-place op references its target through an OutputRef edge).
+  // Target 0's horizon is its LAST dependent (5), pulling both writes in.
+  tdx_graph* g = tdx_graph_new();
+  for (int64_t i = 0; i < 6; i++) assert(tdx_graph_add_node(g, i) == 0);
+  assert(tdx_graph_add_dep(g, 2, 0) == 0);
+  assert(tdx_graph_add_dep(g, 5, 0) == 0);
+  assert(tdx_graph_note_write(g, 0, 0xA) == 0);
+  assert(tdx_graph_note_write(g, 2, 0xA) == 0);
+  assert(tdx_graph_note_write(g, 5, 0xA) == 0);
+  assert(call_stack(g, 0) == (std::vector<int64_t>{0, 2, 5}));
+  // Target 2: dep edge pulls 0 in; its own dependent 5 is within horizon.
+  assert(call_stack(g, 2) == (std::vector<int64_t>{0, 2, 5}));
+  tdx_graph_free(g);
+}
+
+void test_horizon_excludes_later_writers_of_other_targets() {
+  // A write AFTER the target's last dependent must not join the stack.
+  tdx_graph* g = tdx_graph_new();
+  for (int64_t i = 0; i < 4; i++) assert(tdx_graph_add_node(g, i) == 0);
+  assert(tdx_graph_add_dep(g, 1, 0) == 0);  // 1 reads 0
+  assert(tdx_graph_note_write(g, 0, 0xB) == 0);
+  assert(tdx_graph_note_write(g, 3, 0xB) == 0);  // later in-place on 0's storage
+  // Target 1: horizon is 1 (no dependents of 1); node 3 (nr > 1) excluded.
+  assert(call_stack(g, 1) == (std::vector<int64_t>{0, 1}));
+  // Target 0: dependent 3 raises the horizon.
+  assert(call_stack(g, 0) == (std::vector<int64_t>{0, 3}));
+  tdx_graph_free(g);
+}
+
+void test_note_write_prev_reports_previous_touchers() {
+  tdx_graph* g = tdx_graph_new();
+  for (int64_t i = 0; i < 3; i++) assert(tdx_graph_add_node(g, i) == 0);
+  int64_t prev[4];
+  assert(tdx_graph_note_write_prev(g, 0, 0xC, prev, 4) == 0);
+  assert(tdx_graph_note_write_prev(g, 1, 0xC, prev, 4) == 1 && prev[0] == 0);
+  int64_t n = tdx_graph_note_write_prev(g, 2, 0xC, prev, 4);
+  assert(n == 2 && prev[0] == 0 && prev[1] == 1);
+  // cap smaller than count: count still returned, buffer filled to cap.
+  assert(tdx_graph_note_write_prev(g, 0, 0xC, prev, 1) == 2);
+  tdx_graph_free(g);
+}
+
+void test_writer_index_export() {
+  tdx_graph* g = tdx_graph_new();
+  for (int64_t i = 0; i < 3; i++) assert(tdx_graph_add_node(g, i) == 0);
+  assert(tdx_graph_note_write(g, 0, 0xD) == 0);
+  assert(tdx_graph_note_write(g, 2, 0xD) == 0);
+  assert(tdx_graph_note_write(g, 1, 0xE) == 0);
+  uint64_t keys[4];
+  assert(tdx_graph_writer_keys(g, keys, 4) == 2);
+  int64_t nrs[4];
+  assert(tdx_graph_writers_of(g, 0xD, nrs, 4) == 2);
+  assert(nrs[0] == 0 && nrs[1] == 2);  // record order
+  assert(tdx_graph_writers_of(g, 0xE, nrs, 4) == 1 && nrs[0] == 1);
+  assert(tdx_graph_writers_of(g, 0xFF, nrs, 4) == 0);
+  tdx_graph_free(g);
+}
+
+void test_error_paths() {
+  tdx_graph* g = tdx_graph_new();
+  assert(tdx_graph_add_node(g, 7) == 0);
+  assert(tdx_graph_add_node(g, 7) == -1);  // duplicate
+  assert(tdx_graph_add_dep(g, 7, 99) == -1);  // unknown producer
+  assert(tdx_graph_note_write(g, 99, 0xF) == -1);  // unknown writer
+  int64_t buf[1];
+  assert(tdx_graph_call_stack(g, 99, buf, 1) == -1);  // unknown target
+  assert(tdx_graph_has_node(g, 7) == 1);
+  assert(tdx_graph_has_node(g, 99) == 0);
+  tdx_graph_free(g);
+}
+
+}  // namespace
+
+int main() {
+  test_dependency_closure();
+  test_inplace_horizon();
+  test_horizon_excludes_later_writers_of_other_targets();
+  test_note_write_prev_reports_previous_touchers();
+  test_writer_index_export();
+  test_error_paths();
+  std::printf("graph_test: OK\n");
+  return 0;
+}

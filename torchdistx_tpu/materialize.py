@@ -1164,7 +1164,17 @@ def materialize_module_jax(
         #
         # Program identity excludes the seed — the base key is a traced
         # input, so one executable serves a whole seed sweep.
-        base_key = _base_key(seed, rng_impl)
+        #
+        # cache_everything covers the WHOLE section, not just the compiles:
+        # key construction (`jax.random.key` for rbg dispatches a few tiny
+        # eager programs — threefry_seed, convert, concatenate) costs
+        # ~0.5-0.8s PER PROGRAM to compile on a tunneled backend, and JAX's
+        # default admission threshold (min 1s compile time) would silently
+        # refuse to persist them — every process would pay them again.
+        from .utils.compilation_cache import cache_everything
+
+        with cache_everything():
+            base_key = _base_key(seed, rng_impl)
         jobs = []  # (exec_key|None, trace_fn, args, out_shardings|None)
         for b, fins in zip(bin_list, fill_ins):
             names = _bin_names(b)
@@ -1224,7 +1234,6 @@ def materialize_module_jax(
                 misses.append(i)
 
         if misses:
-            from .utils.compilation_cache import cache_everything
 
             def _build(i):
                 key, fn, args, osh = jobs[i]
