@@ -162,6 +162,16 @@ def test_exec_cache_hits_across_bin_programs():
     assert M.exec_cache_hits == before + 1
 
 
+def test_exec_disk_tier_gated_off_cpu_backend():
+    # The serialized-executable disk tier is accelerator-only: CPU
+    # executables are machine-feature-bound, and the suite's cache-hit
+    # invariants must not leak across runs (same rule as the persistent
+    # compilation cache).  This suite runs on the CPU backend.
+    assert M._exec_disk_dir() is None
+    assert M._exec_disk_path(("any", "key")) is None
+    assert M._exec_disk_get(("any", "key")) is None
+
+
 def test_fill_bucket_monotone_and_padded():
     from torchdistx_tpu.ops.aten_jax import fill_bucket
 

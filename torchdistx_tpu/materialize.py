@@ -897,7 +897,126 @@ def _exec_cache_enabled() -> bool:
     return not os.environ.get("TDX_NO_EXEC_CACHE")
 
 
+# Disk tier: AOT executables serialized per program (key = sha256 of the
+# exec key).  A warm PROCESS skips retracing and the XLA-cache machinery
+# outright — deserialize_and_load is the only per-program cost.  Follows
+# the persistent compilation cache's enable flag AND the exec-cache flag;
+# any load failure (jax/runtime version change, different device topology)
+# silently falls back to compiling.
+#
+# Trust model: jax's deserialize_and_load unpickles the blob, so reading a
+# blob executes whatever the writer put there.  The tier therefore only
+# reads/writes a PRIVATE directory: created 0700, and refused entirely if
+# it is not owned by this uid or is group/other-writable (e.g. a shared
+# JAX_COMPILATION_CACHE_DIR on a multi-user cluster).
+
+_EXEC_DISK_MAX_ENTRIES = 256
+
+
+def _exec_disk_dir():
+    import os
+    import stat
+
+    if os.environ.get("TDX_NO_COMPILATION_CACHE"):
+        return None
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # Same rule as utils.compilation_cache: CPU executables are tied to
+        # the build host's machine features (reloading warns or SIGILLs),
+        # and the test suite's cache-hit invariants must not leak across
+        # runs.  The disk tier's value is on accelerators.
+        return None
+    # Same dir resolution as ensure_compilation_cache: a programmatic
+    # jax.config setting wins over the env var over the default.
+    base = (
+        jax.config.jax_compilation_cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
+    )
+    d = os.path.join(base, "tdx_exec")
+    try:
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        st = os.stat(d)
+        if st.st_uid != os.getuid() or (
+            st.st_mode & (stat.S_IWGRP | stat.S_IWOTH)
+        ):
+            return None  # shared/foreign dir: never unpickle from it
+    except OSError:
+        return None
+    return d
+
+
+def _exec_disk_path(key):
+    import hashlib
+    import os
+
+    d = _exec_disk_dir()
+    if d is None:
+        return None
+    # Keys are nested tuples of primitives (strings/ints/bools) by
+    # construction (_hashable_or_none guards hashability; all tensor-ish
+    # parts are stringified) — repr() is deterministic for those.
+    h = hashlib.sha256(repr(key).encode()).hexdigest()
+    return os.path.join(d, f"{h}.pkl")
+
+
+def _exec_disk_get(key):
+    import pickle
+
+    if not _exec_cache_enabled():
+        # TDX_NO_EXEC_CACHE opts out of SERVING cached executables, not
+        # just storing them.
+        return None
+    path = _exec_disk_path(key)
+    if path is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            blob, in_tree, out_tree = pickle.loads(f.read())
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        return deserialize_and_load(blob, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 — stale/foreign blob: recompile
+        return None
+
+
+def _exec_disk_put(key, cfn) -> None:
+    import os
+    import pickle
+
+    path = _exec_disk_path(key)
+    if path is None:
+        return
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload = pickle.dumps(serialize(cfn))
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)  # atomic vs concurrent processes
+        # Bound the tier like the memory tier: prune oldest by mtime.
+        d = os.path.dirname(path)
+        entries = [e for e in os.listdir(d) if e.endswith(".pkl")]
+        if len(entries) > _EXEC_DISK_MAX_ENTRIES:
+            entries.sort(
+                key=lambda e: os.path.getmtime(os.path.join(d, e))
+            )
+            for e in entries[: len(entries) - _EXEC_DISK_MAX_ENTRIES]:
+                try:
+                    os.unlink(os.path.join(d, e))
+                except OSError:
+                    pass
+    except Exception:  # noqa: BLE001 — cache write is pure optimization
+        pass
+
+
 def _exec_cache_get(key):
+    """Memory tier only — the disk tier is consulted explicitly (inside
+    the build pool, so deserialize+load RPCs overlap)."""
     if not _exec_cache_enabled():
         return None
     with _EXEC_CACHE_LOCK:
@@ -911,13 +1030,15 @@ def _exec_cache_get(key):
     return fn
 
 
-def _exec_cache_put(key, fn) -> None:
+def _exec_cache_put(key, fn, *, disk: bool = True) -> None:
     if not _exec_cache_enabled():
         return
     with _EXEC_CACHE_LOCK:
         if key not in _EXEC_CACHE and len(_EXEC_CACHE) >= _EXEC_CACHE_MAX:
             _EXEC_CACHE.pop(next(iter(_EXEC_CACHE)))
         _EXEC_CACHE[key] = fn
+    if disk:
+        _exec_disk_put(key, fn)
 
 
 def materialize_module_jax(
@@ -1228,15 +1349,26 @@ def materialize_module_jax(
         compiled: Dict[int, Any] = {}
         misses = []
         for i, (key, _, _, _) in enumerate(jobs):
+            # Memory tier only here; the disk tier (deserialize + device
+            # load, a tunnel RPC each) runs inside the pool below so loads
+            # overlap like compiles do.
             hit = _exec_cache_get(key) if key is not None else None
             compiled[i] = hit
             if hit is None:
                 misses.append(i)
 
+        had_compiles = False
         if misses:
 
             def _build(i):
+                nonlocal had_compiles
                 key, fn, args, osh = jobs[i]
+                if key is not None:
+                    cfn = _exec_disk_get(key)
+                    if cfn is not None:
+                        _exec_cache_put(key, cfn, disk=False)
+                        return cfn
+                had_compiles = True
                 jfn = (
                     jax.jit(fn, out_shardings=osh)
                     if osh is not None
@@ -1263,7 +1395,7 @@ def materialize_module_jax(
 
         for i, (_, _, args, _) in enumerate(jobs):
             results.update(compiled[i](*args))
-        if jobs and not misses:
+        if jobs and not had_compiles:
             global exec_cache_hits
             exec_cache_hits += 1
 
