@@ -914,37 +914,46 @@ _EXEC_DISK_MAX_ENTRIES = 256
 
 
 def _exec_disk_dir():
-    import os
-    import stat
-
-    if os.environ.get("TDX_NO_COMPILATION_CACHE"):
-        return None
-    import jax
-
-    if jax.default_backend() == "cpu":
-        # Same rule as utils.compilation_cache: CPU executables are tied to
-        # the build host's machine features (reloading warns or SIGILLs),
-        # and the test suite's cache-hit invariants must not leak across
-        # runs.  The disk tier's value is on accelerators.
-        return None
-    # Same dir resolution as ensure_compilation_cache: a programmatic
-    # jax.config setting wins over the env var over the default.
-    base = (
-        jax.config.jax_compilation_cache_dir
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
-    )
-    d = os.path.join(base, "tdx_exec")
+    # Blanket-guarded like ensure_compilation_cache: the cache is a pure
+    # optimization and must never fail materialization (renamed jax config
+    # attrs, read-only HOME, ...).
     try:
+        import os
+        import stat
+
+        if os.environ.get("TDX_NO_COMPILATION_CACHE"):
+            return None
+        import jax
+
+        if jax.default_backend() == "cpu":
+            # Same rule as utils.compilation_cache: CPU executables are
+            # tied to the build host's machine features (reloading warns
+            # or SIGILLs), and the test suite's cache-hit invariants must
+            # not leak across runs.  The tier's value is on accelerators.
+            return None
+        # Same dir resolution as ensure_compilation_cache: a programmatic
+        # jax.config setting wins over the env var over the default.
+        base = (
+            jax.config.jax_compilation_cache_dir
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
+        )
+        if "://" in base:
+            # Remote cache dirs (gs://...) serve JAX's own persistent cache
+            # through its filesystem layer; this tier is local-only — fall
+            # back to the local default rather than mangling the URL into a
+            # cwd-relative path.
+            base = os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
+        d = os.path.join(base, "tdx_exec")
         os.makedirs(d, mode=0o700, exist_ok=True)
         st = os.stat(d)
         if st.st_uid != os.getuid() or (
             st.st_mode & (stat.S_IWGRP | stat.S_IWOTH)
         ):
             return None  # shared/foreign dir: never unpickle from it
-    except OSError:
+        return d
+    except Exception:  # noqa: BLE001
         return None
-    return d
 
 
 def _exec_disk_path(key):
@@ -978,7 +987,11 @@ def _exec_disk_get(key):
             deserialize_and_load,
         )
 
-        return deserialize_and_load(blob, in_tree, out_tree)
+        loaded = deserialize_and_load(blob, in_tree, out_tree)
+        import os
+
+        os.utime(path)  # recency refresh: the prune evicts oldest-by-mtime
+        return loaded
     except Exception:  # noqa: BLE001 — stale/foreign blob: recompile
         return None
 
@@ -994,11 +1007,15 @@ def _exec_disk_put(key, cfn) -> None:
         from jax.experimental.serialize_executable import serialize
 
         payload = pickle.dumps(serialize(cfn))
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # Unique per process AND thread: puts run from the build pool, and
+        # two same-key writers sharing a tmp name would interleave into a
+        # corrupt published blob.
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "wb") as f:
             f.write(payload)
-        os.replace(tmp, path)  # atomic vs concurrent processes
-        # Bound the tier like the memory tier: prune oldest by mtime.
+        os.replace(tmp, path)  # atomic vs concurrent writers
+        # Bound the tier: prune least-recently-used (mtime, refreshed on
+        # disk hits) past the cap.
         d = os.path.dirname(path)
         entries = [e for e in os.listdir(d) if e.endswith(".pkl")]
         if len(entries) > _EXEC_DISK_MAX_ENTRIES:
