@@ -300,6 +300,90 @@ def bench_train_step():
     return out
 
 
+def bench_generate():
+    """KV-cache decode throughput of the flagship stack on one chip.
+
+    The serving-side number: batch-8 greedy decode (prefill 128, 256 new
+    tokens) through the single-program prefill+scan generator
+    (models/generate.py).  Decode is memory-bandwidth-bound; report
+    decode tokens/s and the implied HBM utilization (params read once per
+    step is the traffic floor).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.models.generate import generate
+    from torchdistx_tpu.parallel.mesh import make_mesh, MeshSpec
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
+        ffn_dim=4096, max_seq_len=1024, remat=False,
+    )
+    batch, prompt_len, new = 8, 128, 256
+    params = llama.init_sharded(
+        jax.random.PRNGKey(0), cfg, make_mesh(MeshSpec(fsdp=1))
+    )
+    n_params = sum(int(jnp.size(p)) for p in jax.tree.leaves(params))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    key = jax.random.PRNGKey(2)
+
+    def one_pass(new_tokens, n_iters=8):
+        # Iterations chain on device (each call's output tokens feed the
+        # next prompt) with ONE host sync at the end — per-call syncs
+        # would measure tunnel round-trips, not decode time (same
+        # discipline as the other probes).
+        p = prompt
+        t0 = time.perf_counter()
+        for i in range(n_iters):
+            out = generate(
+                params, p, key, model=llama, cfg=cfg,
+                max_new_tokens=new_tokens,
+            )
+            p = out[:, :prompt_len]
+        int(p[0, 0])  # host sync
+        return (time.perf_counter() - t0) / n_iters
+
+    # Warmup/compile both lengths, syncing via host transfer like the
+    # other probes (block_until_ready does not reliably block on the
+    # tunneled backend).
+    for n in (new // 2, new):
+        out = generate(
+            params, prompt, key, model=llama, cfg=cfg, max_new_tokens=n
+        )
+        int(out[0, 0])
+
+    # Pure decode rate as the MARGINAL between two generation lengths —
+    # the shared prefill (and its 128-token forward) cancels out of the
+    # difference, so the number moves only when decode moves.  The two
+    # lengths are measured in INTERLEAVED passes (min-of-3 each): tunnel
+    # throughput drifts on the scale of seconds, and subtracting
+    # measurements from different drift regimes would dominate the
+    # difference.
+    dt_half = float("inf")
+    dt_full = float("inf")
+    for _ in range(3):
+        dt_half = min(dt_half, one_pass(new // 2))
+        dt_full = min(dt_full, one_pass(new))
+    decode_step_s = max(
+        (dt_full - dt_half) / (new - new // 2), 1e-9
+    )
+    decode_tok_s = batch / decode_step_s
+    # Per decode step every parameter is read once (bf16): the HBM floor.
+    hbm_gb_s = n_params * 2.0 / decode_step_s / 1e9
+    return {
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new,
+        "decode_tokens_per_s": round(decode_tok_s, 1),
+        "e2e_tokens_per_s": round(batch * new / dt_full, 1),
+        "sequences_per_s": round(batch / dt_full, 2),
+        "param_read_gb_per_s": round(hbm_gb_s, 1),
+    }
+
+
 def bench_flash_attention(s=16384, b=1, h=8, d=128):
     """Long-context flash attention fwd+bwd at S=16k on one chip.
 
@@ -387,6 +471,10 @@ def main():
         flash16k = bench_flash_attention()
     except Exception as e:  # noqa: BLE001
         flash16k = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        gen = bench_generate()
+    except Exception as e:  # noqa: BLE001
+        gen = {"error": f"{type(e).__name__}: {e}"}
     cold = bench_cold_uncached()
     # Honest cold ratios: first-ever-run (fresh process, all caches off)
     # against the same eager baselines measured above.
@@ -414,6 +502,7 @@ def main():
                     "resnet50_25m_f32": resnet,
                     "train_step_llama_350m_pallas": train,
                     "flash_attention_16k": flash16k,
+                    "generate_llama_350m_decode": gen,
                     "cold_uncached_s": cold,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),
