@@ -367,21 +367,25 @@ def bench_generate():
     for _ in range(3):
         dt_half = min(dt_half, one_pass(new // 2))
         dt_full = min(dt_full, one_pass(new))
-    decode_step_s = max(
-        (dt_full - dt_half) / (new - new // 2), 1e-9
-    )
-    decode_tok_s = batch / decode_step_s
-    # Per decode step every parameter is read once (bf16): the HBM floor.
-    hbm_gb_s = n_params * 2.0 / decode_step_s / 1e9
-    return {
+    out = {
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new,
-        "decode_tokens_per_s": round(decode_tok_s, 1),
         "e2e_tokens_per_s": round(batch * new / dt_full, 1),
         "sequences_per_s": round(batch / dt_full, 2),
-        "param_read_gb_per_s": round(hbm_gb_s, 1),
     }
+    if dt_full > dt_half:
+        decode_step_s = (dt_full - dt_half) / (new - new // 2)
+        out["decode_tokens_per_s"] = round(batch / decode_step_s, 1)
+        # Per decode step every parameter is read once (bf16): HBM floor.
+        out["param_read_gb_per_s"] = round(
+            n_params * 2.0 / decode_step_s / 1e9, 1
+        )
+    else:
+        # Drift swamped the marginal in every interleaved pass: flag it
+        # rather than reporting an absurd clamped rate.
+        out["decode_rate_error"] = "non-positive marginal (tunnel drift)"
+    return out
 
 
 def bench_flash_attention(s=16384, b=1, h=8, d=128):

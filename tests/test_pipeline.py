@@ -171,6 +171,37 @@ def test_1f1b_grads_match_unpipelined():
     assert pipeline.last_stash_slots < M + P - 1
 
 
+def test_1f1b_gpt2_tied_embedding_grads_match():
+    """GPT-2's tied wte appears in both the stage-0 embed and the
+    last-stage head; its 1F1B gradient (sum of both psum'd contributions)
+    must match unpipelined autodiff of the tied forward."""
+    import dataclasses
+
+    cfg = dataclasses.replace(gpt2.gpt2_test(), n_layers=4)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    B, S, M = 8, 32, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+
+    ref_loss, ref_grads = jax.value_and_grad(gpt2.loss_fn)(
+        params, tokens, targets, cfg
+    )
+    mesh = make_mesh(MeshSpec(fsdp=2, pp=4))
+    loss, grads = jax.jit(
+        lambda p, t, g: gpt2.pp_value_and_grad(
+            p, t, g, cfg, mesh=mesh, pp_axis="pp", n_microbatches=M
+        )
+    )(params, tokens, targets)
+    assert jnp.allclose(loss, ref_loss, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: None
+        if jnp.allclose(a, b, atol=2e-5)
+        else pytest.fail("gpt2 1f1b grad mismatch"),
+        ref_grads,
+        grads,
+    )
+
+
 def test_1f1b_train_step_matches_gpipe():
     import dataclasses
 

@@ -30,6 +30,8 @@ __all__ = [
     "forward",
     "loss_fn",
     "num_params",
+    "pp_pieces",
+    "pp_value_and_grad",
 ]
 
 
@@ -172,28 +174,42 @@ def _layernorm(x, scale, bias, eps):
     return out.astype(x.dtype) * scale.astype(x.dtype) + bias.astype(x.dtype)
 
 
-def forward(
-    params,
-    tokens,
-    cfg: GPT2Config,
-    *,
-    mesh=None,
-    seq_axis: Optional[str] = None,
-    attn_impl: str = "auto",
-    pp_axis: Optional[str] = None,
-    n_microbatches: int = 1,
-):
-    """Token ids ``(B, S)`` → logits ``(B, S, V)`` (f32, tied embeddings)."""
-    b, s = tokens.shape
-    if pp_axis is not None:
-        from ..ops.attention import resolve_stage_attn_impl
+# Shared by the unpipelined forward/loss and the 1F1B pieces — one
+# definition of the embedding, the head, and the loss, so the paths
+# cannot drift.
 
-        attn_impl = resolve_stage_attn_impl(attn_impl)
+
+def _embed(params, tokens, cfg: GPT2Config):
+    """wte[tokens] + wpe[:S] — ``params`` needs only ``wte``/``wpe``."""
+    s = tokens.shape[1]
     x = jnp.take(params["wte"]["weight"], tokens, axis=0).astype(cfg.dtype)
-    x = x + params["wpe"]["weight"][:s].astype(cfg.dtype)[None]
+    return x + params["wpe"]["weight"][:s].astype(cfg.dtype)[None]
+
+
+def _head_logits(params, x, cfg: GPT2Config):
+    """ln_f + tied-embedding logits (f32) — needs ``ln_f``/``wte``."""
+    x = _layernorm(
+        x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
+    )
+    return (x @ params["wte"]["weight"].astype(cfg.dtype).T).astype(
+        jnp.float32
+    )
+
+
+def _ce(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def _build_block(
+    cfg: GPT2Config, *, mesh=None, seq_axis=None, attn_impl="auto"
+):
+    """One transformer block as ``block(x, lp) -> x`` over unstacked layer
+    params — shared by :func:`forward` and the 1F1B pipeline pieces."""
 
     def block(x, lp):
-        bb = x.shape[0]
+        bb, s = x.shape[0], x.shape[1]
         h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
         qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
             cfg.dtype
@@ -217,6 +233,30 @@ def forward(
         )
         return x
 
+    return block
+
+
+def forward(
+    params,
+    tokens,
+    cfg: GPT2Config,
+    *,
+    mesh=None,
+    seq_axis: Optional[str] = None,
+    attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
+):
+    """Token ids ``(B, S)`` → logits ``(B, S, V)`` (f32, tied embeddings)."""
+    if pp_axis is not None:
+        from ..ops.attention import resolve_stage_attn_impl
+
+        attn_impl = resolve_stage_attn_impl(attn_impl)
+    x = _embed(params, tokens, cfg)
+
+    block = _build_block(
+        cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl
+    )
     body = jax.checkpoint(block) if cfg.remat else block
     if pp_axis is not None:
         from ..parallel.pipeline import pipeline_forward
@@ -228,13 +268,7 @@ def forward(
     else:
         x, _ = jax.lax.scan(lambda h, lp: (body(h, lp), None), x,
                             params["layers"])
-    x = _layernorm(
-        x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
-    )
-    logits = (x @ params["wte"]["weight"].astype(cfg.dtype).T).astype(
-        jnp.float32
-    )
-    return logits
+    return _head_logits(params, x, cfg)
 
 
 def init_cache(cfg: GPT2Config, batch: int, max_len: int):
@@ -285,13 +319,7 @@ def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
     x, (new_k, new_v) = jax.lax.scan(
         block, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = _layernorm(
-        x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
-    )
-    logits = (x @ params["wte"]["weight"].astype(cfg.dtype).T).astype(
-        jnp.float32
-    )
-    return logits, {"k": new_k, "v": new_v}
+    return _head_logits(params, x, cfg), {"k": new_k, "v": new_v}
 
 
 def loss_fn(
@@ -310,6 +338,70 @@ def loss_fn(
         params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl,
         pp_axis=pp_axis, n_microbatches=n_microbatches,
     )
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    return _ce(logits, targets)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B pipeline pieces (see parallel.pipeline.pipeline_value_and_grad):
+# wte+wpe embedding on stage 0, blocks pipelined, ln_f + tied-logits loss
+# inside the last stage.
+
+
+def pp_pieces(cfg: GPT2Config, *, mesh=None, attn_impl: str = "auto"):
+    """``(embed_fn, block_fn, head_loss_fn)`` for the 1F1B schedule.
+
+    Shares :func:`_embed` / :func:`_head_logits` / :func:`_ce` with the
+    unpipelined forward/loss so the two paths cannot drift."""
+    from ..ops.attention import resolve_stage_attn_impl
+
+    impl = resolve_stage_attn_impl(attn_impl)
+    block = _build_block(cfg, mesh=mesh, attn_impl=impl)
+    body = jax.checkpoint(block) if cfg.remat else block
+
+    def embed_fn(ep, tokens_mb):
+        return _embed(ep, tokens_mb, cfg)
+
+    def head_loss_fn(hp, h, targets_mb):
+        return _ce(_head_logits(hp, h, cfg), targets_mb)
+
+    return embed_fn, body, head_loss_fn
+
+
+def pp_value_and_grad(
+    params,
+    tokens,
+    targets,
+    cfg: GPT2Config,
+    *,
+    mesh,
+    pp_axis: str = "pp",
+    n_microbatches: int = 1,
+    attn_impl: str = "auto",
+):
+    """``(loss, grads)`` via the 1F1B pipeline.
+
+    The TIED token embedding appears in both the stage-0 embed params and
+    the last-stage head params; its total gradient is the sum of the two
+    (psum'd) contributions — exactly what autodiff of the tied forward
+    produces."""
+    from ..parallel.pipeline import pipeline_value_and_grad
+
+    embed_fn, block_fn, head_loss_fn = pp_pieces(
+        cfg, mesh=mesh, attn_impl=attn_impl
+    )
+    ep = {"wte": params["wte"], "wpe": params["wpe"]}
+    hp = {"ln_f": params["ln_f"], "wte": params["wte"]}
+    loss, (g_ep, g_lp, g_hp) = pipeline_value_and_grad(
+        ep, params["layers"], hp, tokens, targets,
+        embed_fn, block_fn, head_loss_fn,
+        mesh=mesh, axis=pp_axis, n_microbatches=n_microbatches,
+    )
+    grads = {
+        "wte": {
+            "weight": g_ep["wte"]["weight"] + g_hp["wte"]["weight"]
+        },
+        "wpe": g_ep["wpe"],
+        "layers": g_lp,
+        "ln_f": g_hp["ln_f"],
+    }
+    return loss, grads

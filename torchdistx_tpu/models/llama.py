@@ -257,6 +257,24 @@ def _rmsnorm(x, weight, eps):
     return (xf * scale).astype(x.dtype) * weight.astype(x.dtype)
 
 
+# Shared by the unpipelined forward/loss and the 1F1B pieces — one
+# definition of the head and the loss, so the paths cannot drift.
+
+
+def _head_logits(params, x, cfg: LlamaConfig):
+    """Final norm + lm_head (f32) — needs ``norm``/``lm_head``."""
+    x = _rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
+    return (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
+        jnp.float32
+    )
+
+
+def _ce(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
 def _rope(x, positions, theta):
     # x: (B, S, H, D). Rotate pairs (even, odd) halves as in Llama.
     b, s, h, d = x.shape
@@ -395,11 +413,7 @@ def forward(
     else:
         x, _ = jax.lax.scan(lambda h, lp: (body(h, lp), None), x,
                             params["layers"])
-    x = _rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
-    return logits
+    return _head_logits(params, x, cfg)
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int):
@@ -444,11 +458,7 @@ def forward_cached(params, tokens, cfg: LlamaConfig, cache, pos):
     x, (new_k, new_v) = jax.lax.scan(
         block, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = _rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
-    return logits, {"k": new_k, "v": new_v}
+    return _head_logits(params, x, cfg), {"k": new_k, "v": new_v}
 
 
 def loss_fn(
@@ -479,9 +489,7 @@ def loss_fn(
 
         perm, _ = _zigzag_perm(tokens.shape[1], mesh.shape[seq_axis])
         targets = targets[:, perm]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    return _ce(logits, targets)
 
 
 # ---------------------------------------------------------------------------
@@ -503,13 +511,7 @@ def pp_pieces(cfg: LlamaConfig, *, mesh=None, attn_impl: str = "auto"):
         ).astype(cfg.dtype)
 
     def head_loss_fn(hp, h, targets_mb):
-        x = _rmsnorm(h, hp["norm"]["weight"], cfg.norm_eps)
-        logits = (x @ hp["lm_head"]["weight"].astype(cfg.dtype)).astype(
-            jnp.float32
-        )
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets_mb[..., None], axis=-1)[..., 0]
-        return -ll.mean()
+        return _ce(_head_logits(hp, h, cfg), targets_mb)
 
     return embed_fn, body, head_loss_fn
 

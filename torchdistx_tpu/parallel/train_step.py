@@ -191,7 +191,7 @@ def make_train_step(
         if not hasattr(model, "pp_value_and_grad"):
             raise ValueError(
                 f"pp_schedule='1f1b' requires {model.__name__} to expose "
-                "pp_value_and_grad (see models.llama)"
+                "pp_value_and_grad (see models.llama / models.gpt2)"
             )
         value_and_grad = functools.partial(
             model.pp_value_and_grad, cfg=cfg, mesh=mesh, pp_axis=pp_axis,
