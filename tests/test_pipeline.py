@@ -278,10 +278,58 @@ def test_1f1b_wallclock_not_worse_than_gpipe():
     assert t_1f1b <= 1.75 * t_gpipe, (t_1f1b, t_gpipe)
 
 
-def test_1f1b_rejects_custom_loss_and_unsupported_model():
+def test_1f1b_moe_pytree_activations_match_gpipe():
+    """MoE's router aux-loss rides the 1F1B pipeline as a pytree side
+    channel; loss must match the GPipe path (identical per-microbatch
+    routing semantics) and grads must be finite."""
     import dataclasses
 
     from torchdistx_tpu.models import moe
+
+    cfg = dataclasses.replace(moe.moe_test(), n_layers=4)
+    params = moe.init_params(jax.random.PRNGKey(0), cfg)
+    B, S, M = 8, 16, 8
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+    mesh = make_mesh(MeshSpec(fsdp=2, pp=4))
+
+    ref_loss, ref_grads = jax.jit(
+        jax.value_and_grad(
+            lambda p, t, g: moe.loss_fn(
+                p, t, g, cfg, mesh=mesh, pp_axis="pp", n_microbatches=M,
+                attn_impl="jnp",
+            )
+        )
+    )(params, tokens, targets)
+    loss, grads = jax.jit(
+        lambda p, t, g: moe.pp_value_and_grad(
+            p, t, g, cfg, mesh=mesh, pp_axis="pp", n_microbatches=M,
+            attn_impl="jnp",
+        )
+    )(params, tokens, targets)
+    assert jnp.allclose(loss, ref_loss, rtol=1e-5), (loss, ref_loss)
+    jax.tree.map(
+        lambda a, b: None
+        if jnp.allclose(a, b, atol=3e-5)
+        else pytest.fail("moe 1f1b grad mismatch"),
+        ref_grads,
+        grads,
+    )
+
+
+class _NoPP:
+    """Model-module stand-in implementing the base protocol but no
+    pp_value_and_grad (every in-tree family now has one)."""
+
+    __name__ = "nopp"
+    param_specs = staticmethod(llama.param_specs)
+    abstract_params = staticmethod(llama.abstract_params)
+    init_params = staticmethod(llama.init_params)
+    loss_fn = staticmethod(llama.loss_fn)
+
+
+def test_1f1b_rejects_custom_loss_and_unsupported_model():
+    import dataclasses
 
     cfg = dataclasses.replace(llama.llama_test(), n_layers=4)
     mesh = make_mesh(
@@ -294,6 +342,6 @@ def test_1f1b_rejects_custom_loss_and_unsupported_model():
         )
     with pytest.raises(ValueError, match="pp_value_and_grad"):
         ts.make_train_step(
-            moe.moe_test(), mesh, optax.sgd(0.1), pp_axis="pp",
-            pp_schedule="1f1b", model=moe,
+            cfg, mesh, optax.sgd(0.1), pp_axis="pp",
+            pp_schedule="1f1b", model=_NoPP(),
         )

@@ -38,6 +38,8 @@ __all__ = [
     "forward",
     "loss_fn",
     "num_params",
+    "pp_pieces",
+    "pp_value_and_grad",
 ]
 
 
@@ -202,6 +204,50 @@ def moe_ffn(h, router_w, e_gate, e_up, e_down, cfg: MoEConfig):
     return out.reshape(b, s, d), aux
 
 
+def _build_block_core(
+    cfg: MoEConfig, *, mesh=None, seq_axis=None, attn_impl="auto"
+):
+    """One MoE block as ``block(x, aux_sum, lp) -> (x, aux_sum)`` over
+    unstacked layer params — shared by :func:`forward` (scan and GPipe)
+    and the 1F1B pipeline pieces."""
+
+    def block_core(x, aux_sum, lp):
+        bb, s = x.shape[0], x.shape[1]
+        positions = jnp.arange(s)[None]
+        h = llama_mod._rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(bb, s, cfg.n_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
+        q = llama_mod._rope(q, positions, cfg.rope_theta)
+        k = llama_mod._rope(k, positions, cfg.rope_theta)
+        attn = attention(
+            q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
+        )
+        x = x + attn.reshape(bb, s, -1) @ lp["wo"]
+        h = llama_mod._rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        ffn, aux = moe_ffn(
+            h, lp["router"], lp["e_gate"], lp["e_up"], lp["e_down"], cfg
+        )
+        return x + ffn, aux_sum + aux
+
+    return block_core
+
+
+def _pp_block(block_core):
+    """Pipelined activation pytree adapter: the aux channel is one value
+    per batch row (every row of a microbatch carries that microbatch's
+    running aux sum)."""
+
+    def pp_block(act, lp):
+        x_new, aux_new = block_core(act["h"], act["aux"][:, 0], lp)
+        return {
+            "h": x_new,
+            "aux": jnp.broadcast_to(aux_new[..., None], act["aux"].shape),
+        }
+
+    return pp_block
+
+
 def forward(
     params,
     tokens,
@@ -230,42 +276,15 @@ def forward(
 
         attn_impl = resolve_stage_attn_impl(attn_impl)
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
-    positions = jnp.arange(s)[None]
 
-    def block_core(x, aux_sum, lp):
-        bb = x.shape[0]
-        h = llama_mod._rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(bb, s, cfg.n_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(bb, s, cfg.n_kv_heads, cfg.head_dim)
-        q = llama_mod._rope(q, positions, cfg.rope_theta)
-        k = llama_mod._rope(k, positions, cfg.rope_theta)
-        attn = attention(
-            q, k, v, causal=True, impl=attn_impl, mesh=mesh, seq_axis=seq_axis
-        )
-        x = x + attn.reshape(bb, s, -1) @ lp["wo"]
-        h = llama_mod._rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        ffn, aux = moe_ffn(
-            h, lp["router"], lp["e_gate"], lp["e_up"], lp["e_down"], cfg
-        )
-        return x + ffn, aux_sum + aux
+    block_core = _build_block_core(
+        cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl
+    )
 
     if pp_axis is not None:
         from ..parallel.pipeline import pipeline_forward
 
-        def pp_block(act, lp):
-            # aux channel: one value per batch row (every row of a
-            # microbatch carries that microbatch's running aux sum).
-            x_new, aux_new = block_core(
-                act["h"], act["aux"][:, 0], lp
-            )
-            return {
-                "h": x_new,
-                "aux": jnp.broadcast_to(
-                    aux_new[..., None], act["aux"].shape
-                ),
-            }
-
+        pp_block = _pp_block(block_core)
         body = jax.checkpoint(pp_block) if cfg.remat else pp_block
         out = pipeline_forward(
             {"h": x, "aux": jnp.zeros((b, 1), jnp.float32)},
@@ -288,10 +307,7 @@ def forward(
         (x, aux_sum), _ = jax.lax.scan(
             body, (x, jnp.zeros((), jnp.float32)), params["layers"]
         )
-    x = llama_mod._rmsnorm(x, params["norm"]["weight"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]["weight"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
+    logits = llama_mod._head_logits(params, x, cfg)
     if return_aux:
         return logits, aux_sum / cfg.n_layers
     return logits
@@ -315,6 +331,79 @@ def loss_fn(
         attn_impl=attn_impl, pp_axis=pp_axis,
         n_microbatches=n_microbatches, return_aux=True,
     )
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean() + cfg.router_aux_coef * aux
+    return llama_mod._ce(logits, targets) + cfg.router_aux_coef * aux
+
+
+# ---------------------------------------------------------------------------
+# 1F1B pipeline pieces: the router aux-loss accumulator rides the pipeline
+# as a side channel of the activation pytree (same per-microbatch routing
+# semantics as the GPipe path); the last stage folds it into the loss.
+
+
+def pp_pieces(cfg: MoEConfig, *, mesh=None, attn_impl: str = "auto"):
+    """``(embed_fn, block_fn, head_loss_fn)`` for the 1F1B schedule."""
+    from ..ops.attention import resolve_stage_attn_impl
+
+    impl = resolve_stage_attn_impl(attn_impl)
+    pp_block = _pp_block(_build_block_core(cfg, mesh=mesh, attn_impl=impl))
+    body = jax.checkpoint(pp_block) if cfg.remat else pp_block
+
+    def embed_fn(ep, tokens_mb):
+        bt = tokens_mb.shape[0]
+        x = jnp.take(
+            ep["embed"]["weight"], tokens_mb, axis=0
+        ).astype(cfg.dtype)
+        return {"h": x, "aux": jnp.zeros((bt, 1), jnp.float32)}
+
+    def head_loss_fn(hp, act, targets_mb):
+        # Shares llama's head/CE helpers (hp is {"norm","lm_head"}-shaped)
+        # so the 1F1B loss cannot drift from the GPipe/unpipelined one.
+        ce = llama_mod._ce(
+            llama_mod._head_logits(hp, act["h"], cfg), targets_mb
+        )
+        # Each row holds this microbatch's Σ_layers aux; the row mean is
+        # that sum, normalized per layer as in loss_fn.
+        aux = act["aux"].mean() / cfg.n_layers
+        return ce + cfg.router_aux_coef * aux
+
+    return embed_fn, body, head_loss_fn
+
+
+def pp_value_and_grad(
+    params,
+    tokens,
+    targets,
+    cfg: MoEConfig,
+    *,
+    mesh,
+    pp_axis: str = "pp",
+    n_microbatches: int = 1,
+    attn_impl: str = "auto",
+):
+    """``(loss, grads)`` via the 1F1B pipeline (see
+    parallel.pipeline.pipeline_value_and_grad).  Routing/capacity are
+    per-microbatch, as in the GPipe path."""
+    from ..parallel.pipeline import pipeline_value_and_grad
+
+    embed_fn, block_fn, head_loss_fn = pp_pieces(
+        cfg, mesh=mesh, attn_impl=attn_impl
+    )
+    loss, (g_ep, g_lp, g_hp) = pipeline_value_and_grad(
+        {"embed": params["embed"]},
+        params["layers"],
+        {"norm": params["norm"], "lm_head": params["lm_head"]},
+        tokens,
+        targets,
+        embed_fn,
+        block_fn,
+        head_loss_fn,
+        mesh=mesh,
+        axis=pp_axis,
+        n_microbatches=n_microbatches,
+    )
+    return loss, {
+        "embed": g_ep["embed"],
+        "layers": g_lp,
+        "norm": g_hp["norm"],
+        "lm_head": g_hp["lm_head"],
+    }

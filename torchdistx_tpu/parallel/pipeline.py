@@ -249,6 +249,7 @@ def pipeline_value_and_grad(
     mesh,
     axis: str = "pp",
     n_microbatches: int,
+    shared_params=None,
 ):
     """Compute ``(loss, (g_embed, g_layers, g_head))`` with a 1F1B schedule.
 
@@ -257,7 +258,18 @@ def pipeline_value_and_grad(
     over the stage's ``L/P`` layers); ``head_loss_fn(head_params, h,
     targets_mb) -> scalar`` runs on the last stage per microbatch (mean
     over the microbatch's tokens).  ``tokens``/``targets``: ``(B, S)`` with
-    ``B % n_microbatches == 0``.
+    ``B % n_microbatches == 0``.  The activation ``h`` may be a PYTREE —
+    side channels (an MoE router aux-loss accumulator) ride the pipeline
+    in every buffer (stash, hops) alongside the hidden state, exactly as
+    in :func:`pipeline_forward`.
+
+    ``shared_params``: parameters used by BOTH the embedding and the head
+    (GPT-2's tied token embedding).  When given, ``embed_fn(ep, tokens_mb,
+    sp)`` and ``head_loss_fn(hp, h, targets_mb, sp)`` receive it as a
+    trailing argument, it is carried with ONE f32 gradient accumulator,
+    and the return becomes ``(loss, (g_embed, g_layers, g_head,
+    g_shared))`` — duplicating a tied (V, D) tensor into both ep and hp
+    would instead cost two vocab-sized accumulators and psums per stage.
 
     Gradients are accumulated across microbatches in float32 and cast back
     to the parameter dtypes; the loss is the mean over microbatches.  Only
@@ -284,29 +296,69 @@ def pipeline_value_and_grad(
 
     f32 = jnp.float32
 
-    def body(ep, lp, hp, tokens, targets):
+    # Normalize the optional shared-params channel: internally the embed
+    # and head always take a trailing ``sp`` (empty dict when unused).
+    has_shared = shared_params is not None
+    sp_in = shared_params if has_shared else {}
+
+    def embed(ep_, tok_, sp_):
+        return embed_fn(ep_, tok_, sp_) if has_shared else embed_fn(ep_, tok_)
+
+    def head(hp_, y_, tgt_, sp_):
+        return (
+            head_loss_fn(hp_, y_, tgt_, sp_)
+            if has_shared
+            else head_loss_fn(hp_, y_, tgt_)
+        )
+
+    def body(ep, lp, hp, sp, tokens, targets):
+        tmap = jax.tree.map
         p = jax.lax.axis_index(axis)
         up = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         down = [(i, (i - 1) % n_stages) for i in range(n_stages)]
         tok_mb = tokens.reshape(M, bt, S)
         tgt_mb = targets.reshape(M, bt, S)
+        # Activation pytree structure/shapes (side channels included).
         h_ab = jax.eval_shape(
-            embed_fn, ep, jax.ShapeDtypeStruct((bt, S), tokens.dtype)
+            embed, ep, jax.ShapeDtypeStruct((bt, S), tokens.dtype), sp
         )
 
+        def zeros_h():
+            return tmap(lambda a: jnp.zeros(a.shape, a.dtype), h_ab)
+
+        def stash_read(stash, slot):
+            return tmap(
+                lambda st: jax.lax.dynamic_index_in_dim(
+                    st, slot, 0, keepdims=False
+                ),
+                stash,
+            )
+
+        def stash_write(stash, slot, val):
+            return tmap(
+                lambda st, v: jax.lax.dynamic_update_index_in_dim(
+                    st, v, slot, 0
+                ),
+                stash,
+                val,
+            )
+
         def zeros_f32_like(tree):
-            return jax.tree.map(lambda l: jnp.zeros(l.shape, f32), tree)
+            return tmap(lambda l: jnp.zeros(l.shape, f32), tree)
 
         carry0 = dict(
             fc=jnp.zeros((), jnp.int32),
             bc=jnp.zeros((), jnp.int32),
-            stash=jnp.zeros((n_slots,) + h_ab.shape, h_ab.dtype),
-            inc_y=jnp.zeros(h_ab.shape, h_ab.dtype),
+            stash=tmap(
+                lambda a: jnp.zeros((n_slots,) + a.shape, a.dtype), h_ab
+            ),
+            inc_y=zeros_h(),
             inc_m=jnp.full((), -1, jnp.int32),
-            inc_g=jnp.zeros(h_ab.shape, h_ab.dtype),
+            inc_g=zeros_h(),
             g_ep=zeros_f32_like(ep),
             g_lp=zeros_f32_like(lp),
             g_hp=zeros_f32_like(hp),
+            g_sp=zeros_f32_like(sp),
             loss=jnp.zeros((), f32),
         )
 
@@ -314,14 +366,13 @@ def pipeline_value_and_grad(
             # 1. Ingest the forward activation sent last tick (stages > 0).
             slot_in = jnp.maximum(carry["inc_m"], 0) % n_slots
             take = (carry["inc_m"] >= 0) & (p > 0)
-            cur = jax.lax.dynamic_index_in_dim(
-                carry["stash"], slot_in, 0, keepdims=False
-            )
-            stash = jax.lax.dynamic_update_index_in_dim(
+            cur = stash_read(carry["stash"], slot_in)
+            stash = stash_write(
                 carry["stash"],
-                jnp.where(take, carry["inc_y"], cur),
                 slot_in,
-                0,
+                tmap(
+                    lambda y, c: jnp.where(take, y, c), carry["inc_y"], cur
+                ),
             )
 
             fc, bc = carry["fc"], carry["bc"]
@@ -339,27 +390,24 @@ def pipeline_value_and_grad(
             def fwd_slot(stash):
                 h_in = jax.lax.cond(
                     p == 0,
-                    lambda: embed_fn(
+                    lambda: embed(
                         ep,
                         jax.lax.dynamic_index_in_dim(
                             tok_mb, fi, 0, keepdims=False
                         ),
+                        sp,
                     ),
-                    lambda: jax.lax.dynamic_index_in_dim(
-                        stash, fi % n_slots, 0, keepdims=False
-                    ),
+                    lambda: stash_read(stash, fi % n_slots),
                 )
                 stash = jax.lax.cond(
                     p == 0,
-                    lambda s: jax.lax.dynamic_update_index_in_dim(
-                        s, h_in, fi % n_slots, 0
-                    ),
+                    lambda s: stash_write(s, fi % n_slots, h_in),
                     lambda s: s,
                     stash,
                 )
                 y = jax.lax.cond(
                     p == n_stages - 1,
-                    lambda: jnp.zeros(h_ab.shape, h_ab.dtype),
+                    zeros_h,
                     lambda: stage_fn(lp, h_in),
                 )
                 return stash, y
@@ -367,7 +415,7 @@ def pipeline_value_and_grad(
             stash, y_out = jax.lax.cond(
                 do_fwd,
                 fwd_slot,
-                lambda s: (s, jnp.zeros(h_ab.shape, h_ab.dtype)),
+                lambda s: (s, zeros_h()),
                 stash,
             )
             m_out = jnp.where(do_fwd & (p < n_stages - 1), fc, -1)
@@ -379,61 +427,74 @@ def pipeline_value_and_grad(
             bi = jnp.minimum(bc, M - 1)
 
             def bwd_slot():
-                h_in = jax.lax.dynamic_index_in_dim(
-                    stash, bi % n_slots, 0, keepdims=False
-                )
+                h_in = stash_read(stash, bi % n_slots)
                 y, vjp = jax.vjp(stage_fn, lp, h_in)
 
                 def head_branch():
                     tgt = jax.lax.dynamic_index_in_dim(
                         tgt_mb, bi, 0, keepdims=False
                     )
-                    loss_mb, (g_hp_mb, g_y) = jax.value_and_grad(
-                        head_loss_fn, argnums=(0, 1)
-                    )(hp, y, tgt)
-                    return loss_mb.astype(f32), g_hp_mb, g_y
+                    loss_mb, (g_hp_mb, g_y, g_sp_mb) = jax.value_and_grad(
+                        head, argnums=(0, 1, 3)
+                    )(hp, y, tgt, sp)
+                    return loss_mb.astype(f32), g_hp_mb, g_y, g_sp_mb
 
-                loss_mb, g_hp_mb, g_y = jax.lax.cond(
+                loss_mb, g_hp_mb, g_y, g_sp_head = jax.lax.cond(
                     p == n_stages - 1,
                     head_branch,
                     lambda: (
                         jnp.zeros((), f32),
-                        jax.tree.map(jnp.zeros_like, hp),
-                        jnp.zeros(y.shape, y.dtype),
+                        tmap(jnp.zeros_like, hp),
+                        tmap(jnp.zeros_like, y),
+                        tmap(jnp.zeros_like, sp),
                     ),
                 )
-                dh_out = jnp.where(p == n_stages - 1, g_y, carry["inc_g"])
+                dh_out = tmap(
+                    lambda a, b: jnp.where(p == n_stages - 1, a, b),
+                    g_y,
+                    carry["inc_g"],
+                )
                 g_lp_mb, g_h = vjp(dh_out)
 
                 def embed_branch():
                     _, evjp = jax.vjp(
-                        lambda e: embed_fn(
+                        lambda e, s_: embed(
                             e,
                             jax.lax.dynamic_index_in_dim(
                                 tok_mb, bi, 0, keepdims=False
                             ),
+                            s_,
                         ),
                         ep,
+                        sp,
                     )
-                    (g_ep_mb,) = evjp(g_h)
-                    return g_ep_mb
+                    return evjp(g_h)
 
-                g_ep_mb = jax.lax.cond(
+                g_ep_mb, g_sp_embed = jax.lax.cond(
                     p == 0,
                     embed_branch,
-                    lambda: jax.tree.map(jnp.zeros_like, ep),
+                    lambda: (
+                        tmap(jnp.zeros_like, ep),
+                        tmap(jnp.zeros_like, sp),
+                    ),
                 )
-                return loss_mb, g_lp_mb, g_ep_mb, g_hp_mb, g_h
+                # Tied params: one accumulator, both contributions (at most
+                # one is nonzero on any given stage).
+                g_sp_mb = tmap(jnp.add, g_sp_head, g_sp_embed)
+                return loss_mb, g_lp_mb, g_ep_mb, g_hp_mb, g_sp_mb, g_h
 
-            loss_mb, g_lp_mb, g_ep_mb, g_hp_mb, g_out = jax.lax.cond(
+            (
+                loss_mb, g_lp_mb, g_ep_mb, g_hp_mb, g_sp_mb, g_out
+            ) = jax.lax.cond(
                 do_bwd,
                 bwd_slot,
                 lambda: (
                     jnp.zeros((), f32),
-                    jax.tree.map(jnp.zeros_like, lp),
-                    jax.tree.map(jnp.zeros_like, ep),
-                    jax.tree.map(jnp.zeros_like, hp),
-                    jnp.zeros(h_ab.shape, h_ab.dtype),
+                    tmap(jnp.zeros_like, lp),
+                    tmap(jnp.zeros_like, ep),
+                    tmap(jnp.zeros_like, hp),
+                    tmap(jnp.zeros_like, sp),
+                    zeros_h(),
                 ),
             )
 
@@ -444,12 +505,17 @@ def pipeline_value_and_grad(
                 stash=stash,
                 # 4. Hand off: activations up, gradients down — both
                 # unconditional every tick (deadlock freedom).
-                inc_y=jax.lax.ppermute(y_out, axis, up),
+                inc_y=tmap(
+                    lambda l: jax.lax.ppermute(l, axis, up), y_out
+                ),
                 inc_m=jax.lax.ppermute(m_out, axis, up),
-                inc_g=jax.lax.ppermute(g_out, axis, down),
+                inc_g=tmap(
+                    lambda l: jax.lax.ppermute(l, axis, down), g_out
+                ),
                 g_ep=jax.tree.map(acc, carry["g_ep"], g_ep_mb),
                 g_lp=jax.tree.map(acc, carry["g_lp"], g_lp_mb),
                 g_hp=jax.tree.map(acc, carry["g_hp"], g_hp_mb),
+                g_sp=jax.tree.map(acc, carry["g_sp"], g_sp_mb),
                 loss=carry["loss"] + loss_mb,
             )
             return new_carry, None
@@ -464,8 +530,11 @@ def pipeline_value_and_grad(
         g_hp = jax.tree.map(
             cast, jax.lax.psum(out["g_hp"], axis), hp
         )
+        g_sp = jax.tree.map(
+            cast, jax.lax.psum(out["g_sp"], axis), sp
+        )
         g_lp = jax.tree.map(cast, out["g_lp"], lp)
-        return loss, g_ep, g_lp, g_hp
+        return loss, g_ep, g_lp, g_hp, g_sp
 
     rep = lambda tree: jax.tree.map(  # noqa: E731
         lambda l: P(*([None] * l.ndim)), tree
