@@ -172,10 +172,13 @@ def test_1f1b_grads_match_unpipelined():
 
 
 def test_1f1b_gpt2_tied_embedding_grads_match():
-    """GPT-2's tied wte appears in both the stage-0 embed and the
-    last-stage head; its 1F1B gradient (sum of both psum'd contributions)
-    must match unpipelined autodiff of the tied forward."""
+    """GPT-2's tied wte rides the shared_params channel: used by both the
+    stage-0 embed and the last-stage head, carried with ONE vocab-sized
+    f32 accumulator, and its 1F1B gradient (sum of both psum'd
+    contributions) must match unpipelined autodiff of the tied forward."""
     import dataclasses
+
+    from torchdistx_tpu.parallel import pipeline
 
     cfg = dataclasses.replace(gpt2.gpt2_test(), n_layers=4)
     params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
@@ -200,6 +203,15 @@ def test_1f1b_gpt2_tied_embedding_grads_match():
         ref_grads,
         grads,
     )
+    # The memory contract: the tied (V, D) embedding is accumulated ONCE
+    # (g_sp) — duplicating it into both ep and hp would carry two
+    # vocab-sized f32 buffers through every tick of the scan.
+    vocab_f32 = [
+        (name, shape)
+        for name, shape, dtype in pipeline.last_grad_acc_shapes
+        if shape[:1] == (cfg.vocab_size,) and dtype == "float32"
+    ]
+    assert len(vocab_f32) == 1 and vocab_f32[0][0] == "g_sp", vocab_f32
 
 
 def test_1f1b_train_step_matches_gpipe():

@@ -380,26 +380,35 @@ def pp_value_and_grad(
 ):
     """``(loss, grads)`` via the 1F1B pipeline.
 
-    The TIED token embedding appears in both the stage-0 embed params and
-    the last-stage head params; its total gradient is the sum of the two
-    (psum'd) contributions — exactly what autodiff of the tied forward
-    produces."""
+    The TIED token embedding rides the pipeline's ``shared_params``
+    channel: stage 0's embed and the last stage's head both read it, and
+    it is carried with ONE (V, D) f32 gradient accumulator — its total
+    gradient is the (psum'd) sum of the two contributions, exactly what
+    autodiff of the tied forward produces, at half the accumulator
+    memory of duplicating it into both stages' params."""
     from ..parallel.pipeline import pipeline_value_and_grad
 
     embed_fn, block_fn, head_loss_fn = pp_pieces(
         cfg, mesh=mesh, attn_impl=attn_impl
     )
-    ep = {"wte": params["wte"], "wpe": params["wpe"]}
-    hp = {"ln_f": params["ln_f"], "wte": params["wte"]}
-    loss, (g_ep, g_lp, g_hp) = pipeline_value_and_grad(
+
+    def embed_sp(ep_, tokens_mb, sp_):
+        return embed_fn({**ep_, **sp_}, tokens_mb)
+
+    def head_loss_sp(hp_, h, targets_mb, sp_):
+        return head_loss_fn({**hp_, **sp_}, h, targets_mb)
+
+    ep = {"wpe": params["wpe"]}
+    hp = {"ln_f": params["ln_f"]}
+    sp = {"wte": params["wte"]}
+    loss, (g_ep, g_lp, g_hp, g_sp) = pipeline_value_and_grad(
         ep, params["layers"], hp, tokens, targets,
-        embed_fn, block_fn, head_loss_fn,
+        embed_sp, block_fn, head_loss_sp,
         mesh=mesh, axis=pp_axis, n_microbatches=n_microbatches,
+        shared_params=sp,
     )
     grads = {
-        "wte": {
-            "weight": g_ep["wte"]["weight"] + g_hp["wte"]["weight"]
-        },
+        "wte": g_sp["wte"],
         "wpe": g_ep["wpe"],
         "layers": g_lp,
         "ln_f": g_hp["ln_f"],

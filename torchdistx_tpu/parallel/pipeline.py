@@ -234,6 +234,7 @@ def pipeline_forward(
 
 last_stash_slots = 0  # introspection: ring-buffer depth of the last trace
 last_n_ticks = 0
+last_grad_acc_shapes = ()  # (name, shape, dtype) of the last trace's grad accumulators
 
 
 def pipeline_value_and_grad(
@@ -360,6 +361,15 @@ def pipeline_value_and_grad(
             g_hp=zeros_f32_like(hp),
             g_sp=zeros_f32_like(sp),
             loss=jnp.zeros((), f32),
+        )
+        # Introspection for tests: the per-stage f32 gradient accumulators
+        # carried through the scan (proves e.g. a tied embedding is carried
+        # ONCE via shared_params, not duplicated into ep and hp).
+        global last_grad_acc_shapes
+        last_grad_acc_shapes = tuple(
+            (name, tuple(leaf.shape), str(leaf.dtype))
+            for name in ("g_ep", "g_lp", "g_hp", "g_sp")
+            for leaf in jax.tree.leaves(carry0[name])
         )
 
         def tick(carry, t):
@@ -542,17 +552,26 @@ def pipeline_value_and_grad(
     lp_spec = jax.tree.map(
         lambda l: P(axis, *([None] * (l.ndim - 1))), layer_params
     )
-    loss, g_ep, g_lp, g_hp = _shard_map(
+    loss, g_ep, g_lp, g_hp, g_sp = _shard_map(
         body,
         mesh,
         in_specs=(
             rep(embed_params),
             lp_spec,
             rep(head_params),
+            rep(sp_in),
             P(None, None),
             P(None, None),
         ),
-        out_specs=(P(), rep(embed_params), lp_spec, rep(head_params)),
+        out_specs=(
+            P(),
+            rep(embed_params),
+            lp_spec,
+            rep(head_params),
+            rep(sp_in),
+        ),
         manual_axes={axis},
-    )(embed_params, layer_params, head_params, tokens, targets)
+    )(embed_params, layer_params, head_params, sp_in, tokens, targets)
+    if has_shared:
+        return loss, (g_ep, g_lp, g_hp, g_sp)
     return loss, (g_ep, g_lp, g_hp)
