@@ -395,32 +395,67 @@ PyObject* Recorder_note_op(RecorderObject* self, PyObject* args) {
       Py_RETURN_FALSE;  // cross-tape dependency
     dep_nrs.push_back(nr);
   }
-  PyObject* wref = PyWeakref_NewRef(node, nullptr);
-  if (!wref) return nullptr;
-  tdx_graph_add_node(self->graph, op_nr);
-  (*self->wrefs)[op_nr] = wref;
-  for (int64_t d : dep_nrs) tdx_graph_add_dep(self->graph, op_nr, d);
+  // Validate/convert EVERYTHING fallible-by-input before the first graph
+  // mutation: a mid-loop failure after add_node would leave the node
+  // partially recorded, desyncing the native graph from the Python tape
+  // (and double-linking dependents on a later downgrade).
   Py_ssize_t nw = PyList_GET_SIZE(write_keys);
-  std::vector<int64_t> prev;
+  std::vector<uint64_t> wkeys;
+  wkeys.reserve(nw);
   for (Py_ssize_t i = 0; i < nw; i++) {
     uint64_t key =
         PyLong_AsUnsignedLongLongMask(PyList_GET_ITEM(write_keys, i));
     if (PyErr_Occurred()) return nullptr;
-    int64_t n =
-        tdx_graph_writers_of(self->graph, key, nullptr, 0);  // pre-note
+    wkeys.push_back(key);
+  }
+  // Still read-only: resolve every prior writer's `dependents` list now
+  // (writers_of is unaffected by this op's own note_write — op_nr is
+  // skipped below — so pre-computing sees the same writer sets).  After
+  // this loop the only fallible step left is PyList_Append on a
+  // validated list, i.e. OOM.
+  std::vector<PyObject*> deplists;  // borrowed-into-owned, decref'd below
+  std::vector<int64_t> prev;
+  bool fail = false;
+  for (uint64_t key : wkeys) {
+    int64_t n = tdx_graph_writers_of(self->graph, key, nullptr, 0);
     prev.resize((size_t)n);
     tdx_graph_writers_of(self->graph, key, prev.data(), n);
-    tdx_graph_note_write(self->graph, op_nr, key);
     for (int64_t p : prev) {
       if (p == op_nr) continue;
       PyObject* prev_obj = recorder_deref(self, p);
       if (!prev_obj) continue;  // dead toucher: same skip as Python
       PyObject* deplist = PyObject_GetAttrString(prev_obj, "dependents");
-      if (!deplist) return nullptr;
-      int rc = PyList_Append(deplist, node);
-      Py_DECREF(deplist);
-      if (rc < 0) return nullptr;
+      if (!deplist || !PyList_Check(deplist)) {
+        if (deplist) {
+          Py_DECREF(deplist);
+          PyErr_SetString(PyExc_TypeError, "dependents must be a list");
+        }
+        fail = true;
+        break;
+      }
+      deplists.push_back(deplist);
     }
+    if (fail) break;
+  }
+  if (fail) {
+    for (PyObject* dl : deplists) Py_DECREF(dl);
+    return nullptr;
+  }
+  PyObject* wref = PyWeakref_NewRef(node, nullptr);
+  if (!wref) {
+    for (PyObject* dl : deplists) Py_DECREF(dl);
+    return nullptr;
+  }
+  // Mutation phase — nothing below here returns an error (the appends'
+  // only failure mode is OOM, accepted: the graph itself is complete by
+  // then, and Python's fallback re-note never runs unless we error).
+  tdx_graph_add_node(self->graph, op_nr);
+  (*self->wrefs)[op_nr] = wref;
+  for (int64_t d : dep_nrs) tdx_graph_add_dep(self->graph, op_nr, d);
+  for (uint64_t key : wkeys) tdx_graph_note_write(self->graph, op_nr, key);
+  for (PyObject* dl : deplists) {
+    if (PyList_Append(dl, node) < 0) PyErr_Clear();  // OOM only
+    Py_DECREF(dl);
   }
   Py_RETURN_TRUE;
 }

@@ -1019,9 +1019,17 @@ def _exec_disk_put(key, cfn) -> None:
         d = os.path.dirname(path)
         entries = [e for e in os.listdir(d) if e.endswith(".pkl")]
         if len(entries) > _EXEC_DISK_MAX_ENTRIES:
-            entries.sort(
-                key=lambda e: os.path.getmtime(os.path.join(d, e))
-            )
+            # Per-entry safe mtime: a concurrent process unlinking one
+            # file mid-sort must not abort the whole prune (the blanket
+            # except below would silently swallow it, letting the
+            # directory grow unbounded under concurrent writers).
+            def _mtime(e):
+                try:
+                    return os.path.getmtime(os.path.join(d, e))
+                except OSError:
+                    return 0.0
+
+            entries.sort(key=_mtime)
             for e in entries[: len(entries) - _EXEC_DISK_MAX_ENTRIES]:
                 try:
                     os.unlink(os.path.join(d, e))
