@@ -64,6 +64,12 @@ def generate(
         )
     cache = model.init_cache(cfg, b, total)
 
+    # Hoist decode prep (fused projection weights) OUT of the token scan:
+    # one concat per generation, read by every step.
+    prep = getattr(model, "prep_decode", None)
+    if prep is not None:
+        params = prep(params, cfg)
+
     logits, cache = model.forward_cached(params, prompt, cfg, cache, 0)
     first = _sample(
         logits[:, -1], jax.random.fold_in(key, 0), temperature, top_k

@@ -291,8 +291,12 @@ def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
         cfg.dtype
     )[None]
 
-    def block(x, layer):
-        lp, k_cache, v_cache = layer
+    def block(carry, layer):
+        # Caches ride the carry, updated in place with a one-token slice
+        # (scan xs/ys would copy the full per-layer cache every layer —
+        # see llama.forward_cached).
+        x, kc, vc = carry
+        lp, i = layer
         h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
         qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
             cfg.dtype
@@ -301,9 +305,14 @@ def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
         q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        attn = cached_attention(q, k_cache, v_cache, pos).reshape(b, t, -1)
+        kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, pos, 0, 0))
+        attn = cached_attention(
+            q,
+            jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
+            pos,
+        ).reshape(b, t, -1)
         x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
             "bias"
         ].astype(cfg.dtype)
@@ -314,10 +323,12 @@ def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
         x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"]["bias"].astype(
             cfg.dtype
         )
-        return x, (k_cache, v_cache)
+        return (x, kc, vc), None
 
-    x, (new_k, new_v) = jax.lax.scan(
-        block, x, (params["layers"], cache["k"], cache["v"])
+    (x, new_k, new_v), _ = jax.lax.scan(
+        block,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
     )
     return _head_logits(params, x, cfg), {"k": new_k, "v": new_v}
 
