@@ -49,6 +49,7 @@ parameter, not the model).
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -288,8 +289,11 @@ class _LowerCtx:
         return self.node.out_metas[index]
 
 
+@functools.lru_cache(maxsize=4096)
 def _packet_name(func) -> str:
-    # e.g. "aten.uniform_.default"
+    # e.g. "aten.uniform_.default" — OpOverload objects are interned
+    # singletons, so an identity-keyed cache is safe and saves the str()
+    # on every node of every stack analysis.
     return str(func)
 
 
@@ -412,9 +416,26 @@ def _analyze_stack(stack: List[OpNode], record) -> Optional[Tuple]:
                 return ("t", str(a))
             return ("v", a)
 
+        def rec(a):
+            # Structural recursion replacing pytree.tree_flatten +
+            # repr(treedef) (which dominated warm-materialize wall time):
+            # traversal order over tuple/list/dict matches torch pytree's
+            # flatten order (dicts: insertion order), so ``ext_values``
+            # pairs up with replay-time ``tree_map`` consumption.  Exotic
+            # containers (namedtuple/OrderedDict/registered pytrees) would
+            # traverse differently there — send those to the fused path.
+            ta = type(a)
+            if ta is tuple or ta is list:
+                return ("T" if ta is tuple else "L",
+                        tuple(rec(x) for x in a))
+            if ta is dict:
+                return ("D", tuple((k, rec(v)) for k, v in a.items()))
+            if isinstance(a, (tuple, list, dict)):
+                raise _NotGroupable  # subclass: pytree order unknown
+            return norm(a)
+
         try:
-            leaves, treedef = pytree.tree_flatten((n.op.args, n.op.kwargs))
-            norm_leaves = tuple(norm(a) for a in leaves)
+            args_sig = rec((n.op.args, n.op.kwargs))
         except _NotGroupable:
             return None
         except TypeError:
@@ -422,8 +443,7 @@ def _analyze_stack(stack: List[OpNode], record) -> Optional[Tuple]:
         node_sigs.append(
             (
                 _packet_name(n.op.func),
-                repr(treedef),
-                norm_leaves,
+                args_sig,
                 tuple(win_sig(m) for m in n.out_metas),
                 tuple(n.mutated_args),
                 is_view,
@@ -970,6 +990,16 @@ def _exec_disk_path(key):
     return os.path.join(d, f"{h}.pkl")
 
 
+def _exec_disk_has(key) -> bool:
+    """Cheap existence probe (no deserialize/load RPC)."""
+    import os
+
+    if not _exec_cache_enabled() or key is None:
+        return False
+    path = _exec_disk_path(key)
+    return path is not None and os.path.exists(path)
+
+
 def _exec_disk_get(key):
     import pickle
 
@@ -1322,24 +1352,77 @@ def materialize_module_jax(
         with cache_everything():
             base_key = _base_key(seed, rng_impl)
         jobs = []  # (exec_key|None, trace_fn, args, out_shardings|None)
-        for b, fins in zip(bin_list, fill_ins):
-            names = _bin_names(b)
-            bkey = _hashable_or_none(
+        shadow_jobs = []  # compiled+cached for future runs, never executed
+        if bin_list:
+            # ALL fill bins ride ONE program on cached runs: each
+            # executable costs a deserialize + device-load RPC on a
+            # cached-cold run (~0.3-0.6 s over the tunnel), so per-bin
+            # programs made exec loads the cached-cold floor.  But a
+            # merged program compiles its bins SERIALLY server-side,
+            # while separate bins compile CONCURRENTLY — so on a compile
+            # run the bins stay per-program (fast first materialize) and
+            # the merged fillpack is compiled as a SHADOW job in the same
+            # pool (overlapped, results discarded) purely to seed the
+            # cache for future cached-cold runs.
+            fill_names = [n for b in bin_list for n in _bin_names(b)]
+            fkey = _hashable_or_none(
                 (
-                    "fillbin",
-                    str(b["ddt"]),
-                    b["bucket"],
+                    "fillpack",
                     rng_impl,
-                    _bin_entry_key(b),
-                    _mesh_key(names),
+                    tuple(
+                        (str(b["ddt"]), b["bucket"], _bin_entry_key(b))
+                        for b in bin_list
+                    ),
+                    _mesh_key(fill_names),
                 )
             )
-            osh = (
-                {name: shardings[name] for name in names}
+            bin_fns = [_make_bin_fn(b) for b in bin_list]
+
+            def fills_fn(base_key, all_fins):
+                out = {}
+                for fn, fins in zip(bin_fns, all_fins):
+                    out.update(fn(base_key, fins))
+                return out
+
+            osh_all = (
+                {name: shardings[name] for name in fill_names}
                 if shardings is not None
                 else None
             )
-            jobs.append((bkey, _make_bin_fn(b), (base_key, fins), osh))
+            fill_args = (base_key, list(fill_ins))
+            # Existence probe only — a stale blob (e.g. after a runtime
+            # upgrade) routes ONE materialize through a serial merged
+            # compile, which stores a fresh blob (self-healing); probing
+            # loadability here would pay the full deserialize RPC up
+            # front on every cached-cold run instead.
+            merged_ready = fkey is not None and (
+                _exec_cache_get(fkey) is not None or _exec_disk_has(fkey)
+            )
+            if merged_ready:
+                jobs.append((fkey, fills_fn, fill_args, osh_all))
+            else:
+                for b, fn, fins in zip(bin_list, bin_fns, fill_ins):
+                    names = _bin_names(b)
+                    bkey = _hashable_or_none(
+                        (
+                            "fillbin",
+                            str(b["ddt"]),
+                            b["bucket"],
+                            rng_impl,
+                            _bin_entry_key(b),
+                            _mesh_key(names),
+                        )
+                    )
+                    osh = (
+                        {name: shardings[name] for name in names}
+                        if shardings is not None
+                        else None
+                    )
+                    jobs.append((bkey, fn, (base_key, fins), osh))
+                if fkey is not None and _exec_cache_enabled():
+                    shadow_jobs.append(
+                        (fkey, fills_fn, fill_args, osh_all)
+                    )
 
         if tmpl_groups or fused_names:
             # Cacheable only when nothing takes the fused path — the fused
@@ -1382,18 +1465,26 @@ def materialize_module_jax(
             if hit is None:
                 misses.append(i)
 
+        # Shadow jobs (the merged fillpack) ride the same pool — compiled
+        # concurrently with the real misses, stored for future cached-cold
+        # runs, never executed this run.  They do NOT count toward
+        # had_compiles: a run whose every EXECUTED program was cached is
+        # still a cache hit even while it seeds the merged blob.
+        build_list = jobs + shadow_jobs
+        misses += range(len(jobs), len(build_list))
         had_compiles = False
         if misses:
 
             def _build(i):
                 nonlocal had_compiles
-                key, fn, args, osh = jobs[i]
+                key, fn, args, osh = build_list[i]
                 if key is not None:
                     cfn = _exec_disk_get(key)
                     if cfn is not None:
                         _exec_cache_put(key, cfn, disk=False)
                         return cfn
-                had_compiles = True
+                if i < len(jobs):
+                    had_compiles = True
                 jfn = (
                     jax.jit(fn, out_shardings=osh)
                     if osh is not None
@@ -1418,8 +1509,65 @@ def materialize_module_jax(
                         ):
                             compiled[i] = cfn
 
-        for i, (_, _, args, _) in enumerate(jobs):
-            results.update(compiled[i](*args))
+        # Ship every job's host argument leaves in ONE transfer per dtype:
+        # on a tunneled backend each host→device put is a full RPC (~40 ms
+        # measured), and the ~70 tiny index/fill arrays (a few KB total!)
+        # cost seconds when transferred one by one — that dominated
+        # cached-cold wall time.  Pack per dtype on host, put once, and
+        # unpack on device with a small exec-cached program (slice +
+        # reshape is free for XLA).
+        if jobs:
+            all_args = [args for _, _, args, _ in jobs]
+            leaves, treedef = jax.tree.flatten(all_args)
+            host_idx = [
+                i for i, l in enumerate(leaves)
+                if isinstance(l, np.ndarray)
+            ]
+            if host_idx:
+                by_dtype: Dict[str, list] = {}
+                for i in host_idx:
+                    by_dtype.setdefault(str(leaves[i].dtype), []).append(i)
+                order = sorted(by_dtype)
+                layout = tuple(
+                    (dt, tuple(tuple(leaves[i].shape) for i in by_dtype[dt]))
+                    for dt in order
+                )
+                packed = [
+                    np.concatenate(
+                        [leaves[i].ravel() for i in by_dtype[dt]]
+                    )
+                    for dt in order
+                ]
+                unpack_key = ("argpack", layout)
+                ufn = _exec_cache_get(unpack_key)
+                if ufn is None:
+                    ufn = _exec_disk_get(unpack_key)
+                    if ufn is not None:
+                        _exec_cache_put(unpack_key, ufn, disk=False)
+                if ufn is None:
+
+                    def unpack(*bufs):
+                        out = []
+                        for buf, (_, shapes) in zip(bufs, layout):
+                            off = 0
+                            for shp in shapes:
+                                n = int(np.prod(shp, dtype=np.int64))
+                                out.append(
+                                    buf[off:off + n].reshape(shp)
+                                )
+                                off += n
+                        return tuple(out)
+
+                    with cache_everything():
+                        ufn = jax.jit(unpack).lower(*packed).compile()
+                    _exec_cache_put(unpack_key, ufn)
+                unpacked = iter(ufn(*jax.device_put(packed)))
+                for dt in order:
+                    for i in by_dtype[dt]:
+                        leaves[i] = next(unpacked)
+            all_args = jax.tree.unflatten(treedef, leaves)
+        for i in range(len(jobs)):
+            results.update(compiled[i](*all_args[i]))
         if jobs and not had_compiles:
             global exec_cache_hits
             exec_cache_hits += 1

@@ -45,10 +45,18 @@ class GPT2Config:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Training-forward layer-loop unroll; None = auto (see llama).
+    layer_unroll: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def _unroll(self) -> int:
+        if self.layer_unroll:
+            return self.layer_unroll
+        return self.n_layers if self.n_layers <= 32 else 1
 
     @property
     def ffn_dim(self) -> int:
@@ -186,20 +194,37 @@ def _embed(params, tokens, cfg: GPT2Config):
     return x + params["wpe"]["weight"][:s].astype(cfg.dtype)[None]
 
 
-def _head_logits(params, x, cfg: GPT2Config):
-    """ln_f + tied-embedding logits (f32) — needs ``ln_f``/``wte``."""
+def _head(params, x, cfg: GPT2Config):
+    """ln_f + tied-embedding logits in ``cfg.dtype`` — the ONE head
+    definition; needs ``ln_f``/``wte``."""
     x = _layernorm(
         x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.norm_eps
     )
-    return (x @ params["wte"]["weight"].astype(cfg.dtype).T).astype(
-        jnp.float32
-    )
+    return x @ params["wte"]["weight"].astype(cfg.dtype).T
+
+
+def _head_logits(params, x, cfg: GPT2Config):
+    """:func:`_head` under the public f32-logits contract."""
+    return _head(params, x, cfg).astype(jnp.float32)
 
 
 def _ce(logits, targets):
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    """Mean next-token CE in f32 from logits of any float dtype (see
+    llama._ce: logsumexp form, upcast fused into the reduction)."""
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1
+    )
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[
+        ..., 0
+    ].astype(jnp.float32)
+    return (lse - tgt).mean()
+
+
+def _head_ce(params, x, targets, cfg: GPT2Config):
+    """Loss-path :func:`_head` + CE with ``cfg.dtype`` logits (see
+    llama._head_ce; bitwise-identical to ``_ce(_head_logits(...))`` at
+    float32)."""
+    return _ce(_head(params, x, cfg), targets)
 
 
 def _build_block(
@@ -248,6 +273,26 @@ def forward(
     n_microbatches: int = 1,
 ):
     """Token ids ``(B, S)`` → logits ``(B, S, V)`` (f32, tied embeddings)."""
+    x = _forward_hidden(
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
+        attn_impl=attn_impl, pp_axis=pp_axis,
+        n_microbatches=n_microbatches,
+    )
+    return _head_logits(params, x, cfg)
+
+
+def _forward_hidden(
+    params,
+    tokens,
+    cfg: GPT2Config,
+    *,
+    mesh=None,
+    seq_axis: Optional[str] = None,
+    attn_impl: str = "auto",
+    pp_axis: Optional[str] = None,
+    n_microbatches: int = 1,
+):
+    """Embedding + blocks, no ln_f/head (see llama._forward_hidden)."""
     if pp_axis is not None:
         from ..ops.attention import resolve_stage_attn_impl
 
@@ -267,8 +312,8 @@ def forward(
         )
     else:
         x, _ = jax.lax.scan(lambda h, lp: (body(h, lp), None), x,
-                            params["layers"])
-    return _head_logits(params, x, cfg)
+                            params["layers"], unroll=cfg._unroll)
+    return x
 
 
 def init_cache(cfg: GPT2Config, batch: int, max_len: int):
@@ -345,11 +390,12 @@ def loss_fn(
     pp_axis: Optional[str] = None,
     n_microbatches: int = 1,
 ):
-    logits = forward(
-        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis, attn_impl=attn_impl,
-        pp_axis=pp_axis, n_microbatches=n_microbatches,
+    x = _forward_hidden(
+        params, tokens, cfg, mesh=mesh, seq_axis=seq_axis,
+        attn_impl=attn_impl, pp_axis=pp_axis,
+        n_microbatches=n_microbatches,
     )
-    return _ce(logits, targets)
+    return _head_ce(params, x, targets, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -373,7 +419,7 @@ def pp_pieces(cfg: GPT2Config, *, mesh=None, attn_impl: str = "auto"):
         return _embed(ep, tokens_mb, cfg)
 
     def head_loss_fn(hp, h, targets_mb):
-        return _ce(_head_logits(hp, h, cfg), targets_mb)
+        return _head_ce(hp, h, targets_mb, cfg)
 
     return embed_fn, body, head_loss_fn
 
