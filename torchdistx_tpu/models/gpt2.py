@@ -30,6 +30,8 @@ __all__ = [
     "forward",
     "loss_fn",
     "num_params",
+    "init_cache",
+    "forward_cached",
     "pp_pieces",
     "pp_value_and_grad",
 ]

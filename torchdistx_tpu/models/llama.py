@@ -51,6 +51,9 @@ __all__ = [
     "forward",
     "loss_fn",
     "num_params",
+    "init_cache",
+    "forward_cached",
+    "prep_decode",
     "pp_pieces",
     "pp_value_and_grad",
 ]
