@@ -1260,10 +1260,48 @@ def materialize_module_jax(
             # Signature groups: one vmapped template each — the compiled
             # program contains one subgraph per unique layer *kind*, not per
             # layer (compile time O(unique kinds), not O(depth)).
+            #
+            # On a mesh, groups whose instance count is divisible by the
+            # largest axis run the vmap INSIDE shard_map over that axis:
+            # each device replays only its own instances.  Without this the
+            # SPMD partitioner cannot push the per-param out_shardings
+            # back through the unstack/replay machinery and REPLICATES
+            # every group's generation on every device — measured 8 ×
+            # full-model f32 RSS for a 1.35B HF materialize on the
+            # 8-device virtual mesh (and, on real chips, per-device HBM
+            # = the full f32 model, which caps the tape path far below
+            # the 70B north star).  Values are unchanged: per-instance
+            # keys don't depend on placement.  Singleton groups (embed,
+            # norms) stay replicated — their transient is one param, not
+            # the model.
+            shard_axis = None
+            if mesh is not None and mesh.devices.size > 1:
+                shard_axis = max(
+                    mesh.shape, key=lambda a: mesh.shape[a]
+                )
+                if mesh.shape[shard_axis] <= 1:
+                    shard_axis = None
             for g, template, ords, rels, exts in zip(
                 tmpl_groups, templates, ords_in, rels_in, exts_in
             ):
-                res = jax.vmap(template)(fold(ords, rels), exts)
+                keys = fold(ords, rels)
+                n_inst = len(g["names"])
+                ax = shard_axis
+                if ax is not None and n_inst % mesh.shape[ax] == 0:
+                    from jax.sharding import PartitionSpec as _P
+
+                    from .parallel.pipeline import _shard_map
+
+                    row = _P(ax)
+                    res = _shard_map(
+                        lambda k, e: jax.vmap(template)(k, e),
+                        mesh,
+                        in_specs=(row, jax.tree.map(lambda _: row, exts)),
+                        out_specs=row,
+                        manual_axes={ax},
+                    )(keys, exts)
+                else:
+                    res = jax.vmap(template)(keys, exts)
                 for i, name in enumerate(g["names"]):
                     out[name] = res[i]
             # Fused leftovers: union of the remaining targets' call stacks,
