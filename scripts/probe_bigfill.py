@@ -1,0 +1,76 @@
+"""Bisect: why does the multi-output bigfill program replicate?"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+osh_row = NamedSharding(mesh, P("x", None))
+
+
+def report(name, cfn, shard_shapes, full_shapes):
+    txt = cfn.as_text()
+    shard = sum(txt.count(s) for s in shard_shapes)
+    full = sum(txt.count(s) for s in full_shapes)
+    print(f"{name}: shard-shaped={shard} full-shaped={full} "
+          f"allgather={txt.count('all-gather')}")
+
+
+N, M = 32000, 2048
+
+# A: two outputs, dict, concrete key per draw via fold_in on TRACED ords
+ords = np.asarray([3, 7], dtype=np.uint32)
+s1 = np.asarray([0.02, 0.02], dtype=np.float32)
+
+
+def fa(k, ords, s1):
+    out = {}
+    for i, nm in enumerate(["a", "b"]):
+        kk = jax.random.fold_in(k, ords[i])
+        flat = jax.random.normal(kk, (N * M,), dtype=jnp.float32) * s1[i]
+        out[nm] = flat[: N * M].reshape(N, M)
+    return out
+
+
+cfa = jax.jit(fa, out_shardings={"a": osh_row, "b": osh_row}).lower(
+    key, ords, s1
+).compile()
+report("A fold_in-traced 2-out", cfa, [f"f32[{N//8},{M}]", f"f32[{N*M//8}]"],
+       [f"f32[{N},{M}]", f"f32[{N*M}]"])
+
+# B: same but fold_in on STATIC python ints
+def fb(k):
+    out = {}
+    for i, nm in enumerate(["a", "b"]):
+        kk = jax.random.fold_in(k, [3, 7][i])
+        flat = jax.random.normal(kk, (N * M,), dtype=jnp.float32) * 0.02
+        out[nm] = flat[: N * M].reshape(N, M)
+    return out
+
+
+cfb = jax.jit(fb, out_shardings={"a": osh_row, "b": osh_row}).lower(
+    key
+).compile()
+report("B fold_in-static 2-out", cfb, [f"f32[{N//8},{M}]", f"f32[{N*M//8}]"],
+       [f"f32[{N},{M}]", f"f32[{N*M}]"])
+
+# C: one traced fold_in, single output
+def fc(k, o):
+    kk = jax.random.fold_in(k, o[0])
+    return (jax.random.normal(kk, (N * M,), dtype=jnp.float32) * 0.02)[
+        : N * M
+    ].reshape(N, M)
+
+
+cfc = jax.jit(fc, out_shardings=osh_row).lower(key, ords).compile()
+report("C fold_in-traced 1-out", cfc, [f"f32[{N//8},{M}]", f"f32[{N*M//8}]"],
+       [f"f32[{N},{M}]", f"f32[{N*M}]"])
+
+# timing A
+t0 = time.perf_counter()
+r = cfa(key, ords, s1)
+jax.block_until_ready(r)
+print(f"A exec: {time.perf_counter()-t0:.2f}s")

@@ -1,0 +1,57 @@
+"""Which shapes / how many outputs defeat backward sharding propagation?"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+
+
+def build(shapes, names):
+    ords = np.arange(len(shapes), dtype=np.uint32)
+    s1 = np.full(len(shapes), 0.02, dtype=np.float32)
+
+    def fn(k, ords, s1):
+        out = {}
+        for i, (nm, shp) in enumerate(zip(names, shapes)):
+            kk = jax.random.fold_in(jax.random.fold_in(k, ords[i]), 1)
+            n = int(np.prod(shp))
+            flat = jax.random.normal(kk, (n,), dtype=jnp.float32) * s1[i]
+            out[nm] = flat[:n].reshape(shp)
+        return out
+
+    osh = {nm: NamedSharding(mesh, P("x", None)) for nm in names}
+    return jax.jit(fn, out_shardings=osh).lower(key, ords, s1).compile()
+
+
+def full_bufs(cfn, shapes):
+    txt = cfn.as_text()
+    bad = []
+    for shp in set(shapes):
+        n = int(np.prod(shp))
+        if txt.count(f"f32[{n}]") or txt.count(f"f32[{shp[0]},{shp[1]}]"):
+            bad.append(shp)
+    return bad
+
+
+# 1: each suspect shape alone
+for shp in [(32000, 2048), (5504, 2048), (2048, 5504), (2048, 2048)]:
+    c = build([shp], ["a"])
+    print(f"solo {shp}: full-size bufs: {full_bufs(c, [shp])}")
+
+# 2: 24 copies of one shape
+shapes = [(5504, 2048)] * 24
+names = [f"p{i}" for i in range(24)]
+c = build(shapes, names)
+print("24x (5504,2048): full bufs:", full_bufs(c, shapes))
+
+# 3: mixed 170-ish: 2 embed + 24*7 layer shapes
+shapes = [(32000, 2048)] * 2 + (
+    [(2048, 2048)] * 4 + [(5504, 2048)] * 2 + [(2048, 5504)]
+) * 24
+names = [f"p{i}" for i in range(len(shapes))]
+c = build(shapes, names)
+print(f"{len(shapes)} mixed: full bufs:", full_bufs(c, shapes))

@@ -1,0 +1,49 @@
+"""One compiled draw program per class, executed per instance."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+
+CLASSES = [
+    ((2048, 2048), P("x", None), 96),
+    ((5504, 2048), P("x", None), 48),
+    ((2048, 5504), P(None, "x"), 24),
+    ((32000, 2048), P("x", None), 2),
+]
+total = sum(n for _, _, n in CLASSES)
+ords = np.arange(total, dtype=np.uint32)
+keys_all = jax.jit(
+    lambda k, o: jax.vmap(
+        lambda oo: jax.random.fold_in(jax.random.fold_in(k, oo), 1)
+    )(o)
+)(key, ords)
+jax.block_until_ready(keys_all)
+
+t0 = time.perf_counter()
+progs = []
+for shp, spec, n in CLASSES:
+    def f(kk, shp=shp):
+        return jax.random.normal(kk, shp, dtype=jnp.float32) * 0.02
+    c = jax.jit(f, out_shardings=NamedSharding(mesh, spec)).lower(
+        keys_all[0]
+    ).compile()
+    progs.append((c, n))
+print(f"compile {len(CLASSES)} class programs: {time.perf_counter()-t0:.1f}s")
+
+t0 = time.perf_counter()
+outs = []
+i = 0
+for c, n in progs:
+    for _ in range(n):
+        outs.append(c(keys_all[i]))
+        i += 1
+jax.block_until_ready(outs)
+print(f"exec {total} dispatches: {time.perf_counter()-t0:.1f}s")
+import resource
+print(f"ru_maxrss {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss/1048576:.1f}GB")
+print("sharding sample:", outs[0].sharding.spec, outs[-1].sharding.spec)

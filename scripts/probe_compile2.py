@@ -1,0 +1,64 @@
+"""Direct-chain compile cost: inline fold_in vs precomputed key inputs;
+scaling with chain count."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+
+LAYER = ([((2048, 2048), P("x", None))] * 4
+         + [((5504, 2048), P("x", None))] * 2
+         + [((2048, 5504), P(None, "x"))])
+E = [((32000, 2048), P("x", None), "embed"),
+     ((32000, 2048), P("x", None), "lm_head")]
+for li in range(24):
+    for j, (shp, spec) in enumerate(LAYER):
+        E.append((shp, spec, f"l{li}p{j}"))
+ords = np.arange(len(E), dtype=np.uint32)
+osh = {nm: NamedSharding(mesh, spec) for _, spec, nm in E}
+
+
+def fold(k, o):
+    return jax.random.fold_in(jax.random.fold_in(k, o), 1)
+
+
+# precomputed keys (one vmapped fold, executed eagerly)
+keys_all = jax.jit(lambda k, o: jax.vmap(lambda oo: fold(k, oo))(o))(key, ords)
+
+
+def f_keys(keys_in):
+    out = {}
+    for i, (shp, spec, nm) in enumerate(E):
+        out[nm] = jax.random.normal(keys_in[i], shp, dtype=jnp.float32) * 0.02
+    return out
+
+
+t0 = time.perf_counter()
+ck = jax.jit(f_keys, out_shardings=osh).lower(keys_all).compile()
+print(f"precomputed-keys 170 chains: compile {time.perf_counter()-t0:.1f}s")
+t0 = time.perf_counter()
+r = ck(keys_all)
+jax.block_until_ready(list(r.values()))
+print(f"exec {time.perf_counter()-t0:.1f}s")
+
+# scaling: 43 chains (quarter)
+E4 = E[: len(E) // 4]
+osh4 = {nm: osh[nm] for _, _, nm in E4}
+
+
+def f4(keys_in):
+    out = {}
+    for i, (shp, spec, nm) in enumerate(E4):
+        out[nm] = jax.random.normal(keys_in[i], shp, dtype=jnp.float32) * 0.02
+    return out
+
+
+t0 = time.perf_counter()
+c4 = jax.jit(f4, out_shardings=osh4).lower(keys_all).compile()
+print(f"precomputed-keys 43 chains: compile {time.perf_counter()-t0:.1f}s")
+import resource
+print(f"ru_maxrss {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss/1048576:.1f}GB")

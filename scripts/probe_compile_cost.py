@@ -1,0 +1,106 @@
+"""Compile-time shapes: 170 flat chains vs vmapped-per-shape vs split jobs."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+
+LAYER_SHAPES = (
+    [((2048, 2048), P("x", None))] * 4
+    + [((5504, 2048), P("x", None))] * 2
+    + [((2048, 5504), P(None, "x"))]
+)
+
+
+def entries():
+    out = [((32000, 2048), P("x", None), "embed"),
+           ((32000, 2048), P("x", None), "lm_head")]
+    for li in range(24):
+        for j, (shp, spec) in enumerate(LAYER_SHAPES):
+            out.append((shp, spec, f"l{li}p{j}"))
+    return out
+
+
+E = entries()
+ords = np.arange(len(E), dtype=np.uint32)
+
+
+def fold(k, o):
+    return jax.random.fold_in(jax.random.fold_in(k, o), 1)
+
+
+# A: flat chains (current)
+def fa(k, ords):
+    out = {}
+    for i, (shp, spec, nm) in enumerate(E):
+        out[nm] = jax.random.normal(fold(k, ords[i]), shp, dtype=jnp.float32) * 0.02
+    return out
+
+
+osh = {nm: NamedSharding(mesh, spec) for shp, spec, nm in E}
+t0 = time.perf_counter()
+ca = jax.jit(fa, out_shardings=osh).lower(key, ords).compile()
+print(f"A flat 170 chains: compile {time.perf_counter()-t0:.1f}s")
+
+# B: vmapped per shape-class with per-instance keys + constraint + slices
+from collections import defaultdict
+
+classes = defaultdict(list)
+for i, (shp, spec, nm) in enumerate(E):
+    classes[(shp, str(spec))].append((i, spec, nm))
+
+
+def fb(k, ords):
+    out = {}
+    for (shp, _), items in classes.items():
+        idx = jnp.asarray([i for i, _, _ in items], dtype=jnp.uint32)
+        keys = jax.vmap(lambda o: fold(k, o))(ords[idx])
+        spec = items[0][1]
+        if len(items) == 1:
+            out[items[0][2]] = jax.random.normal(keys[0], shp, dtype=jnp.float32) * 0.02
+            continue
+        stacked = jax.vmap(
+            lambda kk: jax.random.normal(kk, shp, dtype=jnp.float32) * 0.02
+        )(keys)
+        stacked = jax.lax.with_sharding_constraint(
+            stacked, NamedSharding(mesh, P(None, *spec))
+        )
+        for j, (_, _, nm) in enumerate(items):
+            out[nm] = stacked[j]
+    return out
+
+
+t0 = time.perf_counter()
+cb = jax.jit(fb, out_shardings=osh).lower(key, ords).compile()
+print(f"B vmapped classes: compile {time.perf_counter()-t0:.1f}s")
+txt = cb.as_text()
+print("B full bufs:", sum(txt.count(f"f32[{a},{b}]") for (a, b), _ in
+                          [( (2048,5504), 0), ((5504,2048), 0), ((32000,2048), 0), ((2048,2048), 0)]))
+
+# C: split per class jobs (compile each separately, sum)
+t0 = time.perf_counter()
+tot = 0.0
+for (shp, _), items in classes.items():
+    def fc(k, o, items=items, shp=shp):
+        out = {}
+        for j, (i, spec, nm) in enumerate(items):
+            out[nm] = jax.random.normal(fold(k, o[j]), shp, dtype=jnp.float32) * 0.02
+        return out
+    o = np.asarray([i for i, _, _ in items], dtype=np.uint32)
+    oshc = {nm: NamedSharding(mesh, spec) for _, spec, nm in items}
+    t1 = time.perf_counter()
+    jax.jit(fc, out_shardings=oshc).lower(key, o).compile()
+    tot += time.perf_counter() - t1
+print(f"C split jobs: total compile {tot:.1f}s")
+
+# exec check for B
+t0 = time.perf_counter()
+r = cb(key, ords)
+jax.block_until_ready(list(r.values()))
+print(f"B exec: {time.perf_counter()-t0:.1f}s")
+import resource
+print(f"ru_maxrss {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss/1048576:.1f}GB")

@@ -1,0 +1,58 @@
+"""(1) normal(k,(n,)).reshape == normal(k,shape)?  (2) CPU draw throughput,
+threefry vs rbg, sharded vs single-device.  (3) does a direct 2-D draw shard
+on dim 1?"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+k = jax.random.key(0)
+a = jax.random.normal(k, (1024 * 512,)).reshape(1024, 512)
+b = jax.random.normal(k, (1024, 512))
+print("flat.reshape == 2d:", bool(jnp.array_equal(a, b)))
+
+k2 = jax.random.key(0, impl="rbg")
+a2 = jax.random.normal(k2, (1024 * 512,)).reshape(1024, 512)
+b2 = jax.random.normal(k2, (1024, 512))
+print("rbg flat.reshape == 2d:", bool(jnp.array_equal(a2, b2)))
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+osh_col = NamedSharding(mesh, P(None, "x"))
+
+f = jax.jit(
+    lambda kk: jax.random.normal(kk, (2048, 5504), dtype=jnp.float32) * 0.02,
+    out_shardings=osh_col,
+).lower(k).compile()
+txt = f.as_text()
+print("direct2d dim1-sharded: full bufs:",
+      txt.count("f32[2048,5504]"), "shard bufs:", txt.count("f32[2048,688]"))
+
+# throughput: 8-dev sharded draw of 512M elements
+N = 512 * 1024 * 1024
+g = jax.jit(
+    lambda kk: jax.random.normal(kk, (N,), dtype=jnp.float32),
+    out_shardings=NamedSharding(mesh, P("x")),
+).lower(k).compile()
+r = g(k); jax.block_until_ready(r)
+t0 = time.perf_counter(); r = g(k); jax.block_until_ready(r)
+dt = time.perf_counter() - t0
+print(f"threefry sharded 512M: {dt:.2f}s = {N/dt/1e6:.0f}M elem/s")
+
+g2 = jax.jit(
+    lambda kk: jax.random.normal(kk, (N,), dtype=jnp.float32),
+    out_shardings=NamedSharding(mesh, P("x")),
+).lower(k2).compile()
+r = g2(k2); jax.block_until_ready(r)
+t0 = time.perf_counter(); r = g2(k2); jax.block_until_ready(r)
+dt = time.perf_counter() - t0
+print(f"rbg sharded 512M: {dt:.2f}s = {N/dt/1e6:.0f}M elem/s")
+
+# single-device
+d0 = jax.devices()[0]
+g3 = jax.jit(lambda kk: jax.random.normal(kk, (N // 8,), dtype=jnp.float32))
+r = g3(k); jax.block_until_ready(r)
+t0 = time.perf_counter(); r = g3(k); jax.block_until_ready(r)
+dt = time.perf_counter() - t0
+print(f"threefry 1-dev 64M: {dt:.2f}s = {N/8/dt/1e6:.0f}M elem/s")

@@ -1,0 +1,55 @@
+"""Can cheaper XLA optimization settings cut compile time for RNG programs?"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+
+LAYER_SHAPES = (
+    [((2048, 2048), P("x", None))] * 4
+    + [((5504, 2048), P("x", None))] * 2
+    + [((2048, 5504), P(None, "x"))]
+)
+E = [((32000, 2048), P("x", None), "embed"),
+     ((32000, 2048), P("x", None), "lm_head")]
+for li in range(24):
+    for j, (shp, spec) in enumerate(LAYER_SHAPES):
+        E.append((shp, spec, f"l{li}p{j}"))
+ords = np.arange(len(E), dtype=np.uint32)
+
+
+def fold(k, o):
+    return jax.random.fold_in(jax.random.fold_in(k, o), 1)
+
+
+def fa(k, ords):
+    out = {}
+    for i, (shp, spec, nm) in enumerate(E):
+        out[nm] = jax.random.normal(fold(k, ords[i]), shp, dtype=jnp.float32) * 0.02
+    return out
+
+
+osh = {nm: NamedSharding(mesh, spec) for shp, spec, nm in E}
+
+for opts in (
+    {"xla_backend_optimization_level": 0},
+    {"xla_backend_optimization_level": 1},
+    {"xla_cpu_enable_fast_math": False},
+):
+    try:
+        t0 = time.perf_counter()
+        c = jax.jit(fa, out_shardings=osh).lower(key, ords).compile(
+            compiler_options=opts
+        )
+        dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = c(key, ords)
+        jax.block_until_ready(list(r.values()))
+        de = time.perf_counter() - t0
+        print(f"{opts}: compile {dt:.1f}s exec {de:.1f}s")
+    except Exception as ex:
+        print(f"{opts}: FAILED {type(ex).__name__}: {str(ex)[:120]}")

@@ -1,0 +1,82 @@
+"""Compile the real 1.35B bigfill job in isolation; inspect HLO + time exec."""
+import re
+import time
+
+import jax
+import numpy as np
+from transformers import LlamaConfig, LlamaForCausalLM
+
+import torchdistx_tpu.deferred_init as di
+from torchdistx_tpu import _tape
+from torchdistx_tpu.deferred_init import _get_record
+from torchdistx_tpu.materialize import (
+    _base_key, _make_bigfill_fn, _named_fakes, _plan_big_fills,
+    _plan_fill_bins, _plan_groups, _resolve_spec,
+)
+from torchdistx_tpu.parallel import MeshSpec, make_mesh
+from torchdistx_tpu.parallel.sharding import fsdp_plan
+from torchdistx_tpu.utils.dtypes import jnp_dtype_of
+
+config = LlamaConfig(
+    vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+    num_hidden_layers=24, num_attention_heads=16,
+    num_key_value_heads=16, max_position_embeddings=2048,
+)
+model = di.deferred_init(LlamaForCausalLM, config)
+mesh = make_mesh(MeshSpec(fsdp=8))
+plan = fsdp_plan()
+
+named = _named_fakes(model)
+fakes = dict(named)
+stacks = {n: _tape.build_call_stack(_get_record(f).node) for n, f in named}
+tdts = {n: jnp_dtype_of(f.dtype) for n, f in named}
+group_list, fused = _plan_groups([n for n, _ in named], fakes, stacks, tdts)
+tape_ordinals = {}
+for name, _ in named:
+    for nd in stacks[name]:
+        tape_ordinals.setdefault(nd.base_nr, len(tape_ordinals))
+bin_list, fill_ins, tmpl = _plan_fill_bins(
+    group_list, stacks, tdts, tape_ordinals
+)
+big_list, big_ins, tmpl = _plan_big_fills(tmpl, stacks, tdts, tape_ordinals)
+print(f"groups={len(group_list)} fused={len(fused)} bins={len(bin_list)} "
+      f"big_subgroups={len(big_list)} rest_groups={len(tmpl)}")
+n_entries = sum(len(sg["entries"]) for sg in big_list)
+print(f"bigfill entries={n_entries}")
+
+from jax.sharding import NamedSharding
+
+names = [e["name"] for sg in big_list for e in sg["entries"]]
+osh = {
+    n: NamedSharding(mesh, _resolve_spec(plan, n, fakes[n], mesh))
+    for n in names
+}
+n_repl = sum(1 for s in osh.values() if s.is_fully_replicated)
+print(f"replicated out_shardings: {n_repl}/{len(osh)}")
+
+base_key = _base_key(0, "threefry2x32")
+fn = _make_bigfill_fn(big_list)
+t0 = time.perf_counter()
+cfn = jax.jit(fn, out_shardings=osh).lower(base_key, list(big_ins)).compile()
+print(f"compile: {time.perf_counter()-t0:.1f}s")
+txt = cfn.as_text()
+# find any big full-size buffers (>= 2048x2048 unsharded)
+fulls = set()
+for m in re.finditer(r"f32\[(\d+)(?:,(\d+))?\]", txt):
+    a = int(m.group(1))
+    b = int(m.group(2)) if m.group(2) else 1
+    if a * b >= 2048 * 2048:
+        fulls.add((a, b))
+print("big buffer shapes:", sorted(fulls)[:20])
+print("allgather:", txt.count("all-gather"), " allreduce:", txt.count("all-reduce"))
+
+t0 = time.perf_counter()
+r = cfn(base_key, list(big_ins))
+jax.block_until_ready(list(r.values()))
+print(f"exec: {time.perf_counter()-t0:.1f}s")
+mem = [0.0]
+with open("/proc/self/status") as f:
+    for line in f:
+        if line.startswith("VmHWM:"):
+            mem[0] = int(line.split()[1]) / 1024
+print(f"VmHWM: {mem[0]:.0f}MB")

@@ -1,0 +1,73 @@
+"""Per-class vmapped stacked draw, returned whole with out_shardings — does
+the draw shard?  compile/exec cost?  Then eager per-instance slicing cost."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()).reshape(8), ("x",))
+key = jax.random.key(0)
+
+
+def fold(k, o):
+    return jax.random.fold_in(jax.random.fold_in(k, o), 1)
+
+
+CLASSES = [
+    ((2048, 2048), P("x", None), 96),
+    ((5504, 2048), P("x", None), 48),
+    ((2048, 5504), P(None, "x"), 24),
+    ((32000, 2048), P("x", None), 1),
+    ((32000, 2048), P("x", None), 1),
+]
+
+tot_compile = 0.0
+tot_exec = 0.0
+outs = []
+off = 0
+for shp, spec, n in CLASSES:
+    ords = np.arange(off, off + n, dtype=np.uint32)
+    off += n
+    if n == 1:
+        def f(k, o, shp=shp):
+            return jax.random.normal(fold(k, o[0]), shp, dtype=jnp.float32) * 0.02
+        osh = NamedSharding(mesh, spec)
+    else:
+        def f(k, o, shp=shp):
+            keys = jax.vmap(lambda oo: fold(k, oo))(o)
+            return jax.vmap(
+                lambda kk: jax.random.normal(kk, shp, dtype=jnp.float32) * 0.02
+            )(keys)
+        osh = NamedSharding(mesh, P(None, *spec))
+    t0 = time.perf_counter()
+    c = jax.jit(f, out_shardings=osh).lower(key, ords).compile()
+    tot_compile += time.perf_counter() - t0
+    txt = c.as_text()
+    full3 = txt.count(f"f32[{n},{shp[0]},{shp[1]}]") if n > 1 else 0
+    t0 = time.perf_counter()
+    r = c(key, ords)
+    r.block_until_ready()
+    dt = time.perf_counter() - t0
+    tot_exec += dt
+    print(f"class {shp}x{n}: compile+ {dt:.1f}s-exec full3d={full3}")
+    outs.append((r, n))
+
+print(f"TOTAL compile {tot_compile:.1f}s exec {tot_exec:.1f}s")
+
+# eager unstack cost
+t0 = time.perf_counter()
+leaves = []
+for r, n in outs:
+    if n == 1:
+        leaves.append(r)
+    else:
+        for i in range(n):
+            leaves.append(r[i])
+jax.block_until_ready(leaves)
+print(f"eager unstack of {sum(n for _, n in outs)}: "
+      f"{time.perf_counter()-t0:.1f}s")
+print("slice sharding:", leaves[2].sharding)
+import resource
+print(f"ru_maxrss {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss/1048576:.1f}GB")

@@ -1,0 +1,89 @@
+"""Profile the tape-path (materialize_module_jax) 1.35B HF materialize.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python scripts/profile_tape_1b.py
+"""
+
+import threading
+import time
+
+_peak = [0.0]
+_stop = [False]
+
+
+def _rss_now_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024
+    return 0.0
+
+
+def _sampler():
+    while not _stop[0]:
+        _peak[0] = max(_peak[0], _rss_now_mb())
+        time.sleep(0.05)
+
+
+def main():
+    import jax
+
+    t0 = time.perf_counter()
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import torchdistx_tpu.deferred_init as di
+    from torchdistx_tpu.materialize import materialize_module_jax
+    from torchdistx_tpu.parallel import MeshSpec, make_mesh
+    from torchdistx_tpu.parallel.sharding import fsdp_plan
+
+    print(f"imports: {time.perf_counter()-t0:.1f}s rss={_rss_now_mb():.0f}MB")
+
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=2048,
+    )
+    t0 = time.perf_counter()
+    model = di.deferred_init(LlamaForCausalLM, config)
+    t_fake = time.perf_counter() - t0
+    n = sum(p.numel() for p in model.parameters())
+    print(f"fake build: {t_fake:.1f}s params={n/1e9:.2f}B rss={_rss_now_mb():.0f}MB")
+
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    th = threading.Thread(target=_sampler, daemon=True)
+    rss0 = _rss_now_mb()
+    _peak[0] = rss0
+    th.start()
+    t0 = time.perf_counter()
+    arrays = materialize_module_jax(model, mesh=mesh, plan=fsdp_plan())
+    jax.block_until_ready(list(arrays.values()))
+    t_mat = time.perf_counter() - t0
+    _stop[0] = True
+    th.join()
+    from torchdistx_tpu import materialize as _m
+
+    print("profile:", {
+        k: (round(v, 2) if isinstance(v, float) else v)
+        for k, v in _m.last_profile.items() if k != "jobs"
+    })
+    for label, s, rss in _m.last_profile.get("jobs", []):
+        print(f"  job {label}: {s:.2f}s rss_after={rss:.0f}MB")
+    print(
+        f"materialize: {t_mat:.1f}s rss_now={_rss_now_mb():.0f}MB "
+        f"peak={_peak[0]:.0f}MB growth_peak={( _peak[0]-rss0)/1024:.1f}GB"
+    )
+    # sharding check on the big singletons
+    for name in (
+        "model.embed_tokens.weight",
+        "lm_head.weight",
+        "model.layers.0.self_attn.q_proj.weight",
+    ):
+        a = arrays[name]
+        print(
+            name, a.shape, str(a.dtype),
+            "replicated" if a.sharding.is_fully_replicated else a.sharding.spec,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,16 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+// Py_T_* / Py_READONLY are public only since CPython 3.12; earlier
+// versions spell them T_* / READONLY in structmember.h.
+#if PY_VERSION_HEX < 0x030C0000
+#include <structmember.h>
+#define Py_T_OBJECT_EX T_OBJECT_EX
+#define Py_T_PYSSIZET T_PYSSIZET
+#define Py_T_LONGLONG T_LONGLONG
+#define Py_READONLY READONLY
+#endif
+
 #include <algorithm>
 #include <cstddef>
 #include <cstdint>
@@ -334,6 +344,12 @@ typedef struct {
   PyObject_HEAD
   tdx_graph* graph;
   std::unordered_map<int64_t, PyObject*>* wrefs;  // op_nr -> weakref(OpNode)
+  // Keep-alive appends dropped on OOM in note_op's mutation phase (the
+  // graph stays consistent, but a node may later be GC'd while natively
+  // referenced — degrading to the documented 'dead toucher' skip).
+  // Exposed as `.dropped_appends` so an OOM-degraded tape is diagnosable
+  // instead of silent (advisor r4).
+  int64_t dropped_appends;
 } RecorderObject;
 
 PyObject* Recorder_tp_new(PyTypeObject* type, PyObject*, PyObject*) {
@@ -341,6 +357,7 @@ PyObject* Recorder_tp_new(PyTypeObject* type, PyObject*, PyObject*) {
   if (!self) return nullptr;
   self->graph = tdx_graph_new();
   self->wrefs = new std::unordered_map<int64_t, PyObject*>();
+  self->dropped_appends = 0;
   return (PyObject*)self;
 }
 
@@ -454,7 +471,10 @@ PyObject* Recorder_note_op(RecorderObject* self, PyObject* args) {
   for (int64_t d : dep_nrs) tdx_graph_add_dep(self->graph, op_nr, d);
   for (uint64_t key : wkeys) tdx_graph_note_write(self->graph, op_nr, key);
   for (PyObject* dl : deplists) {
-    if (PyList_Append(dl, node) < 0) PyErr_Clear();  // OOM only
+    if (PyList_Append(dl, node) < 0) {  // OOM only
+      PyErr_Clear();
+      self->dropped_appends++;
+    }
     Py_DECREF(dl);
   }
   Py_RETURN_TRUE;
@@ -553,6 +573,13 @@ PyMethodDef Recorder_methods[] = {
 
 PySequenceMethods Recorder_as_sequence = {
     Recorder_len,  /* sq_length */
+};
+
+PyMemberDef Recorder_members[] = {
+    {"dropped_appends", Py_T_LONGLONG,
+     offsetof(RecorderObject, dropped_appends), Py_READONLY,
+     "keep-alive appends dropped on OOM (nonzero => degraded tape)"},
+    {nullptr, 0, 0, 0, nullptr},
 };
 
 PyTypeObject RecorderType = {
@@ -791,6 +818,7 @@ PyMODINIT_FUNC PyInit__tdx_stack(void) {
   OutputRefType.tp_members = OutputRef_members;
   RecorderType.tp_new = Recorder_tp_new;
   RecorderType.tp_methods = Recorder_methods;
+  RecorderType.tp_members = Recorder_members;
   if (PyType_Ready(&OutputRefType) < 0 || PyType_Ready(&RecorderType) < 0)
     return nullptr;
   PyObject* m = PyModule_Create(&moduledef);

