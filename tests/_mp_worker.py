@@ -1,0 +1,106 @@
+"""Worker for the multi-process distributed test (FSDPTest-spawn analog).
+
+Launched as ``python tests/_mp_worker.py <rank> <coordinator>`` with
+``XLA_FLAGS=--xla_force_host_platform_device_count=4``: two of these form a
+2-process × 4-device CPU world (multi-controller JAX, gloo collectives).
+
+``run_flows()`` holds the computation itself and is also imported by the
+parent test for the single-process reference run — the "identical
+computation" contract lives in exactly one place.
+
+Prints one ``RESULT {...}`` JSON line with replicated-scalar outcomes; the
+parent asserts cross-rank agreement and equality with the single-process
+run.
+"""
+
+import json
+import sys
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # default implementation already supports cpu collectives
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+
+def run_flows() -> dict:
+    """One DP train step ×2 and one SlowMo cycle on hybrid (ICI×DCN)
+    meshes; returns replicated-scalar digests only (computed under jit, so
+    no process ever needs non-addressable shards on host)."""
+    import jax
+    import optax
+
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.parallel import (
+        MeshSpec, make_hybrid_mesh, train_step as ts,
+    )
+    from torchdistx_tpu.parallel.slowmo import SlowMomentumOptimizer
+
+    cfg = llama.llama_test()
+    out = {}
+
+    # --- data-parallel train step over the hybrid (dp=DCN) mesh ----------
+    mesh = make_hybrid_mesh(MeshSpec(fsdp=4), MeshSpec(dp=2))
+    init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.sgd(0.1))
+    state = init_fn(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+    )
+    batch = {
+        "tokens": jax.device_put(tokens, ts.batch_sharding(mesh)),
+        "targets": jax.device_put(tokens, ts.batch_sharding(mesh)),
+    }
+    state, m = step_fn(state, batch)
+    state, m = step_fn(state, batch)
+    out["loss"] = float(m["loss"])
+    out["wq_sum"] = float(
+        jax.jit(lambda p: p["layers"]["wq"].astype("float32").sum())(
+            state.params
+        )
+    )
+
+    # --- SlowMo stacked-replica step, dp as the (DCN) averaging axis -----
+    mesh2 = make_hybrid_mesh(MeshSpec(tp=4), MeshSpec(dp=2))
+    opt = SlowMomentumOptimizer(optax.sgd(0.1), base_lr=0.1, slowmo_freq=2)
+    init2, step2 = ts.make_slowmo_train_step(cfg, mesh2, opt)
+    st2 = init2(jax.random.PRNGKey(0))
+    t2 = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 4, 32), 0, cfg.vocab_size
+    )
+    b2 = {
+        "tokens": jax.device_put(t2, ts.slowmo_batch_sharding(mesh2)),
+        "targets": jax.device_put(t2, ts.slowmo_batch_sharding(mesh2)),
+    }
+    st2, _ = step2(st2, b2)  # diverge
+    st2, _ = step2(st2, b2)  # averaging step: replicas must sync exactly
+    synced, wq0 = jax.jit(
+        lambda p: (
+            (p["layers"]["wq"][0] == p["layers"]["wq"][1]).all(),
+            p["layers"]["wq"][0].astype("float32").sum(),
+        )
+    )(st2.params)
+    out["slowmo_synced"] = bool(synced)
+    out["slowmo_wq0_sum"] = float(wq0)
+    return out
+
+
+def main() -> None:
+    rank, coord = int(sys.argv[1]), sys.argv[2]
+
+    from torchdistx_tpu.parallel import initialize
+
+    info = initialize(coord, num_processes=2, process_id=rank)
+    assert info.process_count == 2, info
+    assert info.global_device_count == 8, info
+    assert info.local_device_count == 4, info
+
+    out = {"rank": rank, **run_flows()}
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

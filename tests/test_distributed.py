@@ -93,6 +93,78 @@ def test_hybrid_mesh_collective_crosses_axes():
     assert float(total) == 28.0
 
 
+def test_multiprocess_train_and_slowmo_match_single_process():
+    """The real multi-process harness (reference bar: FSDPTest's
+    multi-process spawn, tests/python/test_slowmo_fsdp.py): 2 JAX processes
+    × 4 virtual CPU devices rendezvous through ``initialize``, build hybrid
+    (ICI×DCN) meshes, and run a data-parallel train step plus a SlowMo
+    stacked-replica step with gloo cross-process collectives.  Both ranks
+    must agree on every replicated scalar, SlowMo replicas must sync
+    exactly on the averaging step, and the loss/param digests must match a
+    single-process 8-device run of the identical computation."""
+    import json
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    repo = __file__.rsplit("/tests/", 1)[0]
+    worker = os.path.join(repo, "tests", "_mp_worker.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(r), coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=repo,
+        )
+        for r in range(2)
+    ]
+    # Drain both ranks CONCURRENTLY (a sequential communicate() can
+    # deadlock: rank 1 blocks on a full stderr pipe, stalling a collective
+    # rank 0 is waiting on) and always reap on the way out.
+    try:
+        with ThreadPoolExecutor(2) as pool:
+            outs = list(
+                pool.map(lambda p: p.communicate(timeout=900), procs)
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = {}
+    for r, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"rank {r} rc={p.returncode}\n{out[-2000:]}\n{err[-3000:]}"
+        )
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert line, f"rank {r} produced no RESULT\n{out[-2000:]}"
+        results[r] = json.loads(line[-1][len("RESULT "):])
+
+    # Cross-rank agreement on every replicated scalar.
+    for key in ("loss", "wq_sum", "slowmo_synced", "slowmo_wq0_sum"):
+        assert results[0][key] == results[1][key], (key, results)
+    assert results[0]["slowmo_synced"] is True
+
+    # Single-process reference: the IDENTICAL computation (shared
+    # run_flows) on the local 8-device mesh — the granule fallback gives
+    # the same dp-major layout the 2-process world used.
+    from tests._mp_worker import run_flows
+
+    ref = run_flows()
+    assert ref["slowmo_synced"] is True
+    for key in ("loss", "wq_sum", "slowmo_wq0_sum"):
+        np.testing.assert_allclose(
+            results[0][key], ref[key], rtol=1e-5,
+            err_msg=f"multi-process {key} diverged from single-process",
+        )
+
+
 def test_initialize_single_process_group():
     """Real coordinator rendezvous, 1-process world, in a subprocess (the
     distributed client mutates process-global runtime state)."""

@@ -114,9 +114,12 @@ def _slice_granules(devices) -> list:
     """
     # All-or-nothing key domain (mirrors make_hybrid_mesh): mixing
     # slice_index with process_index fallbacks would interleave unrelated
-    # id spaces in the sorted granule order.
+    # id spaces in the sorted granule order.  Degenerate metadata — every
+    # device reporting the SAME slice_index, as multi-process CPU backends
+    # do (slice 0 everywhere) — carries no DCN structure; fall through to
+    # process_index (one granule per host).
     slice_keys = [getattr(d, "slice_index", None) for d in devices]
-    if all(k is not None for k in slice_keys):
+    if all(k is not None for k in slice_keys) and len(set(slice_keys)) > 1:
         keys = slice_keys
     else:
         keys = [getattr(d, "process_index", 0) for d in devices]
@@ -175,7 +178,17 @@ def make_hybrid_mesh(
 
     from jax.sharding import Mesh
 
-    if all(getattr(d, "slice_index", None) is not None for d in devices):
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    # Multi-process CPU backends report slice 0 on EVERY device — metadata
+    # that carries no DCN structure; those take the granule fallback below
+    # (grouped by process_index).  Real accelerators keep the topology-
+    # aware path even with one slice, so a genuine mismatch (dcn extent 2
+    # on a single-slice pod) still raises instead of silently relabeling
+    # an ICI boundary as DCN.
+    degenerate_cpu = len(slice_ids) == 1 and all(
+        getattr(d, "platform", None) == "cpu" for d in devices
+    )
+    if None not in slice_ids and not degenerate_cpu:
         # Real slice metadata (TPU pods): use jax's slice- and
         # ICI-topology-aware placement, and let genuine topology errors
         # (unmappable ici factors, wrong dcn extent) propagate instead of
