@@ -313,25 +313,31 @@ def _head_ce(params, x, targets, cfg: LlamaConfig):
     return _ce(_head(params, x, cfg), targets)
 
 
-def _rope(x, positions, theta):
-    # x: (B, S, H, D). Rotate pairs (even, odd) halves as in Llama.
-    b, s, h, d = x.shape
-    half = d // 2
+def _rope_tables(positions, theta, half, dtype):
+    """(cos, sin) of shape (B, S, 1, half) — position-only, so callers
+    iterating layers (the decode scan) compute them ONCE per step."""
     freqs = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
     angles = positions[:, :, None].astype(jnp.float32) * freqs  # (B, S, half)
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :].astype(dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(dtype)
+    return cos, sin
+
+
+def _rope_apply(x, cos, sin):
+    half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    out = jnp.concatenate(
-        [
-            x1 * cos.astype(x.dtype) - x2 * sin.astype(x.dtype),
-            x2 * cos.astype(x.dtype) + x1 * sin.astype(x.dtype),
-        ],
-        axis=-1,
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     )
-    return out
+
+
+def _rope(x, positions, theta):
+    # x: (B, S, H, D). Rotate pairs (even, odd) halves as in Llama.
+    return _rope_apply(
+        x, *_rope_tables(positions, theta, x.shape[-1] // 2, x.dtype)
+    )
 
 
 def _build_block(
@@ -533,6 +539,11 @@ def forward_cached(params, tokens, cfg: LlamaConfig, cache, pos):
     positions = jnp.broadcast_to(pos + jnp.arange(t), (b, t))
     n_q = cfg.n_heads * cfg.head_dim
     n_kv = cfg.n_kv_heads * cfg.head_dim
+    # Rope tables are position-only — computed ONCE per step here, not per
+    # layer inside the scan.
+    cos, sin = _rope_tables(
+        positions, cfg.rope_theta, cfg.head_dim // 2, cfg.dtype
+    )
 
     def block(carry, layer):
         x, kc, vc = carry
@@ -546,8 +557,8 @@ def forward_cached(params, tokens, cfg: LlamaConfig, cache, pos):
         v = qkv[..., n_q + n_kv:].reshape(
             b, t, cfg.n_kv_heads, cfg.head_dim
         )
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope_apply(q, cos, sin)
+        k = _rope_apply(k, cos, sin)
         kc = jax.lax.dynamic_update_slice(kc, k[None], (i, 0, pos, 0, 0))
         vc = jax.lax.dynamic_update_slice(vc, v[None], (i, 0, pos, 0, 0))
         attn = cached_attention(

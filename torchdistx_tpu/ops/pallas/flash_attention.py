@@ -85,6 +85,11 @@ _FWD_BLOCK_KV = None
 # (interleaved); falls back per-dimension when S doesn't divide.
 _FWD_BLOCK_Q_DEFAULT = 512
 _FWD_BLOCK_KV_DEFAULT = 2048
+# In-body kv sub-blocking of the forward kernel (a sweep knob; splitting
+# alone measured neutral-to-slightly-negative on v5e — Mosaic does not
+# overlap MXU/VPU across the sub-chains — so the default stays 1).
+_FWD_SPLIT = None
+_FWD_SPLIT_DEFAULT = 1
 
 
 def _pick_block(s_pad: int, override, default) -> int:
@@ -142,7 +147,7 @@ def _diag_clamp(causal: bool, bq: int, bkv: int, clamp):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, bq, bkv, s,
+    *, scale, causal, bq, bkv, s, split,
 ):
     import jax.experimental.pallas as pl
 
@@ -160,54 +165,101 @@ def _fwd_kernel(
 
     # Causal: skip kv blocks entirely above the diagonal.
     run = (q_start + bq - 1 >= k_start) if causal else True
+    # Masking is needed only where correctness demands it: the one kv
+    # step whose block intersects the causal diagonal (bq ≤ bkv ⇒ at most
+    # one per q block) or carries padded cols.  Everything below runs the
+    # mask-free body — the iota/compare/select passes are ~1/3 of the
+    # per-step VPU element work and ~90% of steps don't need them.  The
+    # two bodies are scalar-branched with pl.when (a real Mosaic branch;
+    # a lax.cond variant measured slower).
+    needs_mask = _needs_mask(causal, q_start, k_start, bkv, s)
 
-    @pl.when(run)
-    def _body():
+    def _body(apply_mask):
         # Matmul inputs keep their storage dtype (bf16 on TPU → full MXU
         # rate) with f32 accumulation; only softmax math runs f32 on the
         # VPU.  An earlier revision upcast to f32 *before* the dots, which
         # quarters MXU throughput.  Softmax runs in the log2 domain (scale
         # folds in log2 e; exp2 is the native transcendental).
+        #
+        # The kv block is processed as ``split`` sub-blocks with ONE
+        # combined max/rescale for the whole block: the per-sub chains
+        # (qk matmul → mask → exp2 → p·v) are mutually independent, so
+        # Mosaic can run sub-block j+1's MXU matmuls while sub-block j's
+        # exp2/rowsum occupies the VPU.  The un-split body serializes
+        # MXU and VPU every step — measured 0.26 fwd MFU at S=16k where
+        # the softmax VPU passes cost ~2× the matmul time.  Same math as
+        # un-split (identical m_next for every sub-block); only f32
+        # rowsum association changes.
         q = q_ref[0, 0]  # (bq, d)
-        k = k_ref[0, 0]  # (bkv, d)
-        logits = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * (scale * _LOG2E)
-        )
+        sub = bkv // split
 
-        # Mask only what correctness needs: padded kv cols always (they
-        # must not enter l), the causal triangle when the block touches
-        # the diagonal.  Padded q ROWS need no mask: their logits are
-        # finite (zero-padded q) and their outputs are sliced off.
-        # (A lax.cond skipping interior blocks was measured SLOWER —
-        # Mosaic loses pipelining across the branch.)
-        kpos = k_start + _iota((bq, bkv), 1)
-        keep = kpos < s
-        if causal:
-            keep &= (q_start + _iota((bq, bkv), 0)) >= kpos
-        logits = jnp.where(keep, logits, _MASK)
+        def masked(lj, j):
+            # Mask only what correctness needs: padded kv cols (they must
+            # not enter l), the causal triangle when the sub-block touches
+            # the diagonal.  Padded q ROWS need no mask: their logits are
+            # finite (zero-padded q) and their outputs are sliced off.
+            # Interior causal sub-blocks (fully below the diagonal, no
+            # padding) skip the iota/compare/select passes entirely —
+            # they are ~40% of the per-step VPU element work and only
+            # ~12% of blocks need them.
+            kpos = k_start + j * sub + _iota((bq, sub), 1)
+            keep = kpos < s
+            if causal:
+                keep &= (q_start + _iota((bq, sub), 0)) >= kpos
+            return jnp.where(keep, lj, _MASK)
 
         # Row statistics computed on (bq, 1) slices: the scratch tiles are
         # physically (bq, 128) (f32 tiling grain), but running the
         # max/exp/rescale math lane-replicated would add bq·128 exps per
         # step — a ~50% increase over the bq·bkv softmax exps themselves.
+        #
+        # One combined max/rescale for the whole block.  Variants
+        # measured and rejected at S=16k (v5e): per-sub online updates
+        # (extra acc rescales, no overlap win), lax.cond-gated masking
+        # (predication costs more than the iota/where it saves — 10.6 →
+        # 13.7 ms), sub-splitting alone barely moves (Mosaic does not
+        # overlap MXU/VPU across the split).
+        logit_parts = []
+        for j in range(split):
+            lj = (
+                jax.lax.dot_general(
+                    q, k_ref[0, 0, j * sub:(j + 1) * sub, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * (scale * _LOG2E)
+            )
+            logit_parts.append(masked(lj, j) if apply_mask else lj)
         m_prev = m_ref[...][:, :1]  # (bq, 1)
         l_prev = l_ref[...][:, :1]
-        row_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
-        m_next = jnp.maximum(m_prev, row_max)
+        m_next = m_prev
+        for lj in logit_parts:
+            m_next = jnp.maximum(
+                m_next, jnp.max(lj, axis=-1, keepdims=True)
+            )
         alpha = jnp.exp2(m_prev - m_next)  # (bq, 1)
-        p = jnp.exp2(logits - m_next)  # (bq, bkv)
-        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_next = l_prev * alpha
+        pv = None
+        for j, lj in enumerate(logit_parts):
+            p = jnp.exp2(lj - m_next)  # (bq, sub)
+            l_next = l_next + jnp.sum(p, axis=-1, keepdims=True)
+            vj = v_ref[0, 0, j * sub:(j + 1) * sub, :]
+            dot = jax.lax.dot_general(
+                p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            pv = dot if pv is None else pv + dot
         l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
         m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         acc_ref[...] = acc_ref[...] * alpha + pv
+
+    @pl.when(run & needs_mask)
+    def _body_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _body_plain():
+        _body(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -236,8 +288,13 @@ def _fa_forward_padded(q, k, v, s, *, causal: bool, interpret: bool):
     nq, nk = s_pad // bq, s_pad // bkv
     scale = 1.0 / (d**0.5)
 
+    split = _FWD_SPLIT or _FWD_SPLIT_DEFAULT
+    while split > 1 and (bkv % split or (bkv // split) % 128):
+        split -= 1
+
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s,
+        split=split,
     )
 
     kv_clamp = _diag_clamp(causal, bq, bkv, jnp.minimum)
@@ -281,7 +338,8 @@ def _fa_forward_padded(q, k, v, s, *, causal: bool, interpret: bool):
 
 
 def _recompute_p(
-    q, k, lse, q_start, k_start, *, scale, causal, bq, bkv, s, s_pad
+    q, k, lse, q_start, k_start, *, scale, causal, bq, bkv, s, s_pad,
+    apply_mask=True,
 ):
     """Recompute the softmax block from the saved (natural-log) lse.
 
@@ -292,6 +350,10 @@ def _recompute_p(
     real rows every padded col sits above the diagonal; padded q-row /
     kv-col contributions otherwise cancel against zero-padded do/k/v, and
     padded dk/dv rows are sliced off by the caller.)
+
+    ``apply_mask=False`` skips the iota/compare/select passes — callers
+    branch on the same block-level condition the forward uses (at most one
+    kv block per q block intersects the diagonal).
     """
     logits = (
         jax.lax.dot_general(
@@ -301,6 +363,8 @@ def _recompute_p(
         * (scale * _LOG2E)
     )
     p = jnp.exp2(logits - lse * _LOG2E)
+    if not apply_mask:
+        return p
     if causal:
         kpos = k_start + _iota((bq, bkv), 1)
         keep = (q_start + _iota((bq, bkv), 0)) >= kpos
@@ -309,6 +373,16 @@ def _recompute_p(
         kpos = k_start + _iota((bq, bkv), 1)
         p = jnp.where(kpos < s, p, 0.0)
     return p
+
+
+def _needs_mask(causal, q_start, k_start, bkv, s):
+    """Block-level mask condition shared by fwd and bwd kernels: the kv
+    block crosses the causal diagonal for this q block, or carries padded
+    cols.  (Worst causal pair: first q row vs last kv col.)"""
+    needs = k_start + bkv > s
+    if causal:
+        needs |= k_start + bkv - 1 > q_start
+    return needs
 
 
 def _dq_kernel(
@@ -328,9 +402,9 @@ def _dq_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     run = (q_start + bq - 1 >= k_start) if causal else True
+    needs_mask = _needs_mask(causal, q_start, k_start, bkv, s)
 
-    @pl.when(run)
-    def _body():
+    def _body(apply_mask):
         # bf16 matmul inputs + f32 accumulation (see _fwd_kernel note).
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -342,6 +416,7 @@ def _dq_kernel(
         p = _recompute_p(
             q, k, lse, q_start, k_start,
             scale=scale, causal=causal, bq=bq, bkv=bkv, s=s, s_pad=s_pad,
+            apply_mask=apply_mask,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -352,6 +427,14 @@ def _dq_kernel(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    @pl.when(run & needs_mask)
+    def _body_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _body_plain():
+        _body(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -377,9 +460,9 @@ def _dkv_kernel(
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     run = (q_start + bq - 1 >= k_start) if causal else True
+    needs_mask = _needs_mask(causal, q_start, k_start, bkv, s)
 
-    @pl.when(run)
-    def _body():
+    def _body(apply_mask):
         # bf16 matmul inputs + f32 accumulation (see _fwd_kernel note).
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -391,6 +474,7 @@ def _dkv_kernel(
         p = _recompute_p(
             q, k, lse, q_start, k_start,
             scale=scale, causal=causal, bq=bq, bkv=bkv, s=s, s_pad=s_pad,
+            apply_mask=apply_mask,
         )  # (bq, bkv)
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -405,6 +489,14 @@ def _dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    @pl.when(run & needs_mask)
+    def _body_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _body_plain():
+        _body(False)
 
     @pl.when(idx == n_idx - 1)
     def _finish():
