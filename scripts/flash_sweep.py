@@ -81,8 +81,8 @@ def decompose():
 
 CONFIGS = [
     # (bwd_q, bwd_kv, fwd_q, fwd_kv)
-    (1024, 1024, 1024, 1024),   # r3 defaults
-    (512, 1024, 512, 2048),     # r4 tuned (current defaults)
+    (1024, 1024, 1024, 1024),   # CURRENT defaults (r5, mask-free bodies)
+    (512, 1024, 512, 2048),     # r4 tuned
     (512, 1024, 1024, 1024),
     (1024, 1024, 512, 2048),
     (256, 2048, 512, 2048),

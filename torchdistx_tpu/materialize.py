@@ -790,6 +790,47 @@ def _make_bigfill_class_fn(sg):
     return fn
 
 
+def _pack_host_leaves(leaves):
+    """Group ``np.ndarray`` leaves by dtype into one flat buffer each.
+
+    Returns ``(by_dt, order, layout, packed)``: slot indices per dtype,
+    sorted dtype order, the static layout (shapes per slot — program
+    identity for the unpack), and the concatenated host buffers.  Shared
+    by the argpack transfer and the mono executable so the offset
+    arithmetic exists once.
+    """
+    import numpy as np
+
+    by_dt: Dict[str, list] = {}
+    for i, l in enumerate(leaves):
+        if isinstance(l, np.ndarray):
+            by_dt.setdefault(str(l.dtype), []).append(i)
+    order = sorted(by_dt)
+    layout = tuple(
+        (dt, tuple(tuple(leaves[i].shape) for i in by_dt[dt]))
+        for dt in order
+    )
+    packed = [
+        np.concatenate([leaves[i].ravel() for i in by_dt[dt]])
+        for dt in order
+    ]
+    return by_dt, order, layout, packed
+
+
+def _unpack_bufs(bufs, by_dt, order, layout):
+    """Traced inverse of :func:`_pack_host_leaves`: slot → value dict."""
+    import numpy as np
+
+    vals = {}
+    for buf, (dt, shapes) in zip(bufs, layout):
+        off = 0
+        for slot, shp in zip(by_dt[dt], shapes):
+            n = int(np.prod(shp, dtype=np.int64))
+            vals[slot] = buf[off:off + n].reshape(shp)
+            off += n
+    return vals
+
+
 def _bin_entry_key(b):
     """Exec-cache identity of a bin program (scalar params are traced
     inputs, NOT identity — a changed init std reuses the executable)."""
@@ -1278,7 +1319,7 @@ def materialize_module_jax(
     import jax
 
     ensure_compilation_cache()
-    global last_profile
+    global exec_cache_hits, last_profile
     last_profile = {"jobs": []}
     _prof_t0 = time.perf_counter()
 
@@ -1739,7 +1780,105 @@ def materialize_module_jax(
                  (base_key, ords_in, rels_in, exts_in), osh)
             )
 
-        last_profile["plan_s"] = time.perf_counter() - _prof_t0
+        # --- Mono executable: the WHOLE single-chip materialization as ONE
+        # program.  On a tunneled backend the cached-cold floor is the
+        # executable-load RPCs (deserialize + device load each); the mono
+        # path needs exactly one exec load, one packed host→device
+        # transfer, and one dispatch — measured ~25% faster cached-cold
+        # than the per-program loads on gpt2small AND gpt2xl (interleaved
+        # A/B).  Composed from the CANONICAL job set — the merged fillpack
+        # + the rest program — NOT this run's `jobs` list, whose shape
+        # differs between the first run (per-bin jobs) and cached runs
+        # (merged fillpack): a key over `jobs` could never hit the blob
+        # its own first run seeded.  Identity = canonical keys + packed
+        # layout, so any change in architecture/plan/dtype misses cleanly;
+        # per-job caches remain the fallback.  Compiled as a shadow job on
+        # miss — overlapped with the real compiles.  Single-device only:
+        # mesh runs are local (no tunnel RPC economics).
+        import os as _os
+
+        mono_key = None
+        mono_jobs = []
+        if (
+            jobs
+            and mesh is None
+            and not unsupported
+            and _exec_cache_enabled()
+            and not _os.environ.get("TDX_NO_MONO")
+        ):
+            if bin_list:
+                mono_jobs.append((fkey, fills_fn, fill_args))
+            if tmpl_groups or fused_names:
+                mono_jobs.append(
+                    (rest_key, compute_rest,
+                     (base_key, ords_in, rels_in, exts_in))
+                )
+            if mono_jobs and all(k is not None for k, _, _ in mono_jobs):
+                all_args_m = [a for _, _, a in mono_jobs]
+                leaves_m, treedef_m = jax.tree.flatten(all_args_m)
+                # Every non-host leaf must be the base key (true for all
+                # current job shapes); anything else falls back silently.
+                if all(
+                    isinstance(l, np.ndarray) or l is base_key
+                    for l in leaves_m
+                ):
+                    by_dt_m, order_m, layout_m, packed_m = (
+                        _pack_host_leaves(leaves_m)
+                    )
+                    mono_key = _hashable_or_none(
+                        (
+                            "mono",
+                            tuple(k for k, _, _ in mono_jobs),
+                            layout_m,
+                            rng_impl,
+                        )
+                    )
+        if mono_key is not None:
+
+            def _mono_fn(bk, *bufs):
+                vals = _unpack_bufs(bufs, by_dt_m, order_m, layout_m)
+                new_leaves = [
+                    vals.get(i, bk) for i in range(len(leaves_m))
+                ]
+                out = {}
+                for (_, fn, _), a in zip(
+                    mono_jobs, jax.tree.unflatten(treedef_m, new_leaves)
+                ):
+                    out.update(fn(*a))
+                return out
+
+            mfn = _exec_cache_get(mono_key)
+            if mfn is None:
+                mfn = _exec_disk_get(mono_key)
+                if mfn is not None:
+                    _exec_cache_put(mono_key, mfn, disk=False)
+            if mfn is not None:
+                # Phase stamps land here; the downstream stamps are
+                # setdefault so the mono timings aren't overwritten.
+                last_profile["plan_s"] = time.perf_counter() - _prof_t0
+                last_profile["compile_s"] = 0.0
+                _tm = time.perf_counter()
+                buf_dev = jax.device_put(packed_m)
+                last_profile["transfer_s"] = time.perf_counter() - _tm
+                _tm = time.perf_counter()
+                results.update(mfn(base_key, *buf_dev))
+                if _profile_enabled():
+                    jax.block_until_ready(list(results.values()))
+                    last_profile["jobs"].append(
+                        ("mono", time.perf_counter() - _tm, _rss_mb_now())
+                    )
+                last_profile["exec_s"] = time.perf_counter() - _tm
+                exec_cache_hits += 1
+                # Everything executed; the sections below see empty work.
+                jobs, class_jobs, shadow_jobs = [], [], []
+            else:
+                shadow_jobs.append(
+                    (mono_key, _mono_fn, (base_key, *packed_m), None)
+                )
+
+        last_profile.setdefault(
+            "plan_s", time.perf_counter() - _prof_t0
+        )
         _prof_t0 = time.perf_counter()
         compiled: Dict[int, Any] = {}
         misses = []
@@ -1797,7 +1936,9 @@ def materialize_module_jax(
                         ):
                             compiled[i] = cfn
 
-        last_profile["compile_s"] = time.perf_counter() - _prof_t0
+        last_profile.setdefault(
+            "compile_s", time.perf_counter() - _prof_t0
+        )
         _prof_t0 = time.perf_counter()
         # Ship every job's host argument leaves in ONE transfer per dtype:
         # on a tunneled backend each host→device put is a full RPC (~40 ms
@@ -1815,25 +1956,8 @@ def materialize_module_jax(
         all_args = [args for _, _, args, _ in jobs]
         if jobs and mesh is None:
             leaves, treedef = jax.tree.flatten(all_args)
-            host_idx = [
-                i for i, l in enumerate(leaves)
-                if isinstance(l, np.ndarray)
-            ]
-            if host_idx:
-                by_dtype: Dict[str, list] = {}
-                for i in host_idx:
-                    by_dtype.setdefault(str(leaves[i].dtype), []).append(i)
-                order = sorted(by_dtype)
-                layout = tuple(
-                    (dt, tuple(tuple(leaves[i].shape) for i in by_dtype[dt]))
-                    for dt in order
-                )
-                packed = [
-                    np.concatenate(
-                        [leaves[i].ravel() for i in by_dtype[dt]]
-                    )
-                    for dt in order
-                ]
+            by_dtype, order, layout, packed = _pack_host_leaves(leaves)
+            if packed:
                 unpack_key = ("argpack", layout)
                 ufn = _exec_cache_get(unpack_key)
                 if ufn is None:
@@ -1843,16 +1967,13 @@ def materialize_module_jax(
                 if ufn is None:
 
                     def unpack(*bufs):
-                        out = []
-                        for buf, (_, shapes) in zip(bufs, layout):
-                            off = 0
-                            for shp in shapes:
-                                n = int(np.prod(shp, dtype=np.int64))
-                                out.append(
-                                    buf[off:off + n].reshape(shp)
-                                )
-                                off += n
-                        return tuple(out)
+                        vals = _unpack_bufs(bufs, by_dtype, order, layout)
+                        # dtype-major slot order — matches the consuming
+                        # loop below AND executables cached by earlier
+                        # versions of this layout key.
+                        return tuple(
+                            vals[i] for dt in order for i in by_dtype[dt]
+                        )
 
                     with cache_everything():
                         ufn = jax.jit(unpack).lower(*packed).compile()
@@ -1862,7 +1983,9 @@ def materialize_module_jax(
                     for i in by_dtype[dt]:
                         leaves[i] = next(unpacked)
             all_args = jax.tree.unflatten(treedef, leaves)
-        last_profile["transfer_s"] = time.perf_counter() - _prof_t0
+        last_profile.setdefault(
+            "transfer_s", time.perf_counter() - _prof_t0
+        )
         _prof_t0 = time.perf_counter()
         _prof = _profile_enabled()
         for i in range(len(jobs)):
@@ -1895,9 +2018,10 @@ def materialize_module_jax(
             last_profile["jobs"].append(
                 ("bigfillcls", time.perf_counter() - _tbf, _rss_mb_now())
             )
-        last_profile["exec_s"] = time.perf_counter() - _prof_t0
+        last_profile.setdefault(
+            "exec_s", time.perf_counter() - _prof_t0
+        )
         if (jobs or class_jobs) and not had_compiles:
-            global exec_cache_hits
             exec_cache_hits += 1
 
     # Torch fallback for ops with no lowering: replay on host, transfer with

@@ -61,8 +61,9 @@ def _pad_len(s: int) -> int:
     return -(-s // 8) * 8
 
 
-# Backward block-size overrides (None = measured-best default).
-# Module-level knobs so the bench/tuning harness can sweep them.
+# Block-size overrides (None = measured-best default).  Module-level
+# knobs so the bench/tuning harness (scripts/flash_sweep.py) can sweep
+# them.
 #
 # NOTE (advisor r4): these globals are read at TRACE time and are not part
 # of any jit cache key — a sweep that mutates them under a caller's cached
@@ -70,26 +71,20 @@ def _pad_len(s: int) -> int:
 # call ``jax.clear_caches()`` after each override change (the bench
 # harness does).
 #
-# The
-# asymmetric default (bq 512, bkv 1024) measured 12.7% faster than
-# 1024/1024 at S=16k, d=128 on v5e (interleaved comparison, drift
-# cancelled): the halved f32 dq accumulator and q/do blocks leave more
-# scoped VMEM for double-buffering the streamed side.
+# With the mask-free interior bodies, SQUARE blocks measure best both
+# directions at S=16k d=128 on v5e (adjacent same-window runs:
+# fwd+bwd 23.5 ms at the round-4 (512,2048)/(512,1024) defaults →
+# 21.9 ms with fwd 1024² → 20.6 ms with bwd 1024² as well): at bq == bkv
+# exactly one kv step per q block pays the masked body, and the square
+# shape balances the dq/dkv accumulator footprints.
 _BWD_BLOCK_Q = None
 _BWD_BLOCK_KV = None
-_BWD_BLOCK_Q_DEFAULT = 512
+_BWD_BLOCK_Q_DEFAULT = 1024
 _BWD_BLOCK_KV_DEFAULT = 1024
 _FWD_BLOCK_Q = None
 _FWD_BLOCK_KV = None
-# fwd (512, 2048) measured 12.8% faster than (1024, 1024) at S=16k
-# (interleaved); falls back per-dimension when S doesn't divide.
-_FWD_BLOCK_Q_DEFAULT = 512
-_FWD_BLOCK_KV_DEFAULT = 2048
-# In-body kv sub-blocking of the forward kernel (a sweep knob; splitting
-# alone measured neutral-to-slightly-negative on v5e — Mosaic does not
-# overlap MXU/VPU across the sub-chains — so the default stays 1).
-_FWD_SPLIT = None
-_FWD_SPLIT_DEFAULT = 1
+_FWD_BLOCK_Q_DEFAULT = 1024
+_FWD_BLOCK_KV_DEFAULT = 1024
 
 
 def _pick_block(s_pad: int, override, default) -> int:
@@ -147,7 +142,7 @@ def _diag_clamp(causal: bool, bq: int, bkv: int, clamp):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale, causal, bq, bkv, s, split,
+    *, scale, causal, bq, bkv, s,
 ):
     import jax.experimental.pallas as pl
 
@@ -180,75 +175,48 @@ def _fwd_kernel(
         # VPU.  An earlier revision upcast to f32 *before* the dots, which
         # quarters MXU throughput.  Softmax runs in the log2 domain (scale
         # folds in log2 e; exp2 is the native transcendental).
-        #
-        # The kv block is processed as ``split`` sub-blocks with ONE
-        # combined max/rescale for the whole block: the per-sub chains
-        # (qk matmul → mask → exp2 → p·v) are mutually independent, so
-        # Mosaic can run sub-block j+1's MXU matmuls while sub-block j's
-        # exp2/rowsum occupies the VPU.  The un-split body serializes
-        # MXU and VPU every step — measured 0.26 fwd MFU at S=16k where
-        # the softmax VPU passes cost ~2× the matmul time.  Same math as
-        # un-split (identical m_next for every sub-block); only f32
-        # rowsum association changes.
         q = q_ref[0, 0]  # (bq, d)
-        sub = bkv // split
-
-        def masked(lj, j):
+        logits = (
+            jax.lax.dot_general(
+                q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * (scale * _LOG2E)
+        )
+        if apply_mask:
             # Mask only what correctness needs: padded kv cols (they must
-            # not enter l), the causal triangle when the sub-block touches
+            # not enter l), the causal triangle when the block touches
             # the diagonal.  Padded q ROWS need no mask: their logits are
             # finite (zero-padded q) and their outputs are sliced off.
-            # Interior causal sub-blocks (fully below the diagonal, no
-            # padding) skip the iota/compare/select passes entirely —
-            # they are ~40% of the per-step VPU element work and only
-            # ~12% of blocks need them.
-            kpos = k_start + j * sub + _iota((bq, sub), 1)
+            kpos = k_start + _iota((bq, bkv), 1)
             keep = kpos < s
             if causal:
-                keep &= (q_start + _iota((bq, sub), 0)) >= kpos
-            return jnp.where(keep, lj, _MASK)
+                keep &= (q_start + _iota((bq, bkv), 0)) >= kpos
+            logits = jnp.where(keep, logits, _MASK)
 
         # Row statistics computed on (bq, 1) slices: the scratch tiles are
         # physically (bq, 128) (f32 tiling grain), but running the
         # max/exp/rescale math lane-replicated would add bq·128 exps per
         # step — a ~50% increase over the bq·bkv softmax exps themselves.
         #
-        # One combined max/rescale for the whole block.  Variants
-        # measured and rejected at S=16k (v5e): per-sub online updates
-        # (extra acc rescales, no overlap win), lax.cond-gated masking
-        # (predication costs more than the iota/where it saves — 10.6 →
-        # 13.7 ms), sub-splitting alone barely moves (Mosaic does not
-        # overlap MXU/VPU across the split).
-        logit_parts = []
-        for j in range(split):
-            lj = (
-                jax.lax.dot_general(
-                    q, k_ref[0, 0, j * sub:(j + 1) * sub, :],
-                    (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                * (scale * _LOG2E)
-            )
-            logit_parts.append(masked(lj, j) if apply_mask else lj)
+        # Rejected variants, measured at S=16k (v5e): in-body kv
+        # sub-splitting with a combined max (no MXU/VPU overlap — Mosaic
+        # barriers every exp2 behind all qk matmuls), per-sub online
+        # updates (extra acc rescales), lax.cond-gated masking
+        # (predication costs more than the iota/where it saves, 10.6 →
+        # 13.7 ms).  The win that stuck is the scalar-branched mask-free
+        # interior body (see pl.when below).
         m_prev = m_ref[...][:, :1]  # (bq, 1)
         l_prev = l_ref[...][:, :1]
-        m_next = m_prev
-        for lj in logit_parts:
-            m_next = jnp.maximum(
-                m_next, jnp.max(lj, axis=-1, keepdims=True)
-            )
+        row_max = jnp.max(logits, axis=-1, keepdims=True)  # (bq, 1)
+        m_next = jnp.maximum(m_prev, row_max)
         alpha = jnp.exp2(m_prev - m_next)  # (bq, 1)
-        l_next = l_prev * alpha
-        pv = None
-        for j, lj in enumerate(logit_parts):
-            p = jnp.exp2(lj - m_next)  # (bq, sub)
-            l_next = l_next + jnp.sum(p, axis=-1, keepdims=True)
-            vj = v_ref[0, 0, j * sub:(j + 1) * sub, :]
-            dot = jax.lax.dot_general(
-                p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            pv = dot if pv is None else pv + dot
+        p = jnp.exp2(logits - m_next)  # (bq, bkv)
+        l_next = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
         m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
         acc_ref[...] = acc_ref[...] * alpha + pv
@@ -288,13 +256,8 @@ def _fa_forward_padded(q, k, v, s, *, causal: bool, interpret: bool):
     nq, nk = s_pad // bq, s_pad // bkv
     scale = 1.0 / (d**0.5)
 
-    split = _FWD_SPLIT or _FWD_SPLIT_DEFAULT
-    while split > 1 and (bkv % split or (bkv // split) % 128):
-        split -= 1
-
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s,
-        split=split,
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv, s=s
     )
 
     kv_clamp = _diag_clamp(causal, bq, bkv, jnp.minimum)
@@ -385,6 +348,29 @@ def _needs_mask(causal, q_start, k_start, bkv, s):
     return needs
 
 
+def _p_ds(
+    q, k, v, do, lse, delta, q_start, k_start,
+    *, scale, causal, bq, bkv, s, s_pad, apply_mask,
+):
+    """The shared backward block chain: recomputed softmax ``p`` and the
+    logit gradient ``ds = p ∘ (do·vᵀ − Δ)·scale`` (cast to the matmul
+    dtype).  Every backward kernel (dq, dk/dv, fused) consumes exactly
+    these two — one definition so a change to the gradient identities
+    cannot silently diverge between the long-context and training paths.
+    """
+    p = _recompute_p(
+        q, k, lse, q_start, k_start,
+        scale=scale, causal=causal, bq=bq, bkv=bkv, s=s, s_pad=s_pad,
+        apply_mask=apply_mask,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    return p, ds
+
+
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
     *, scale, causal, bq, bkv, s, s_pad,
@@ -406,25 +392,14 @@ def _dq_kernel(
 
     def _body(apply_mask):
         # bf16 matmul inputs + f32 accumulation (see _fwd_kernel note).
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]  # (bq, 1)
-        delta = delta_ref[0, 0]
-
-        p = _recompute_p(
-            q, k, lse, q_start, k_start,
+        _, ds = _p_ds(
+            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0],
+            lse_ref[0, 0], delta_ref[0, 0], q_start, k_start,
             scale=scale, causal=causal, bq=bq, bkv=bkv, s=s, s_pad=s_pad,
             apply_mask=apply_mask,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
         acc_ref[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds, k_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -465,26 +440,17 @@ def _dkv_kernel(
     def _body(apply_mask):
         # bf16 matmul inputs + f32 accumulation (see _fwd_kernel note).
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
-
-        p = _recompute_p(
-            q, k, lse, q_start, k_start,
+        p, ds = _p_ds(
+            q, k_ref[0, 0], v_ref[0, 0], do, lse_ref[0, 0],
+            delta_ref[0, 0], q_start, k_start,
             scale=scale, causal=causal, bq=bq, bkv=bkv, s=s, s_pad=s_pad,
             apply_mask=apply_mask,
-        )  # (bq, bkv)
+        )
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)  # (bq, bkv)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -504,9 +470,154 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _dqkv_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dk_ref,
+    dv_ref, dk_acc, dv_acc,
+    *, scale, causal, bq, bkv, s, s_pad, nq,
+):
+    """Single-kv-block backward (``nk == 1`` — the training regime, where
+    S fits one kv block): dq, dk, dv in ONE kernel.
+
+    With the whole kv extent resident, dq needs no cross-step
+    accumulation (each q block's dq is complete after its own grid step),
+    so the classic dq/dkv grid-order conflict disappears.  One kernel
+    halves the per-layer pallas-call count AND computes the p/dp
+    recompute once instead of twice (5 block matmuls instead of 7, half
+    the bwd exp2s) — the two-kernel split at S=1024/d=64 measured ~0.64
+    ms per call with ~0.09 ms of ideal matmul work, i.e. per-call
+    overhead and duplicated softmax dominated the training backward.
+    """
+    import jax.experimental.pallas as pl
+
+    idx = pl.program_id(2)  # (gqa group, q block) pairs
+    n_idx = pl.num_programs(2)
+    qi = idx % nq
+    q_start = qi * bq
+    k_start = 0
+
+    @pl.when(idx == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (q_start + bq - 1 >= k_start) if causal else True
+    needs_mask = _needs_mask(causal, q_start, k_start, bkv, s)
+
+    def _body(apply_mask):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        do = do_ref[0, 0]
+        p, ds = _p_ds(
+            q, k, v_ref[0, 0], do, lse_ref[0, 0], delta_ref[0, 0],
+            q_start, k_start,
+            scale=scale, causal=causal, bq=bq, bkv=bkv, s=s, s_pad=s_pad,
+            apply_mask=apply_mask,
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dq_ref[0, 0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dq_ref.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(run & needs_mask)
+    def _body_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _body_plain():
+        _body(False)
+
+    # Above-diagonal q blocks never run the body: their dq block is pure
+    # padding-free zeros.
+    @pl.when(jnp.logical_not(run))
+    def _zero_dq():
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    @pl.when(idx == n_idx - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_backward_fused_nk1(q, k, v, out, lse, do, s, *, causal, interpret):
+    """One-kernel backward for ``s_pad <= bkv`` (single kv block)."""
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    b, hq, s_pad, d = q.shape
+    hkv = k.shape[1]
+    groups = hq // hkv
+    bq = _pick_block(s_pad, _BWD_BLOCK_Q, _BWD_BLOCK_Q_DEFAULT)
+    bkv = s_pad  # single block
+    nq = s_pad // bq
+    scale = 1.0 / (d**0.5)
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+    gq_q_spec = pl.BlockSpec(
+        (1, 1, bq, d),
+        lambda bi, hkvi, idx, g=groups, n=nq: (
+            bi, hkvi * g + idx // n, idx % n, 0
+        ),
+    )
+    gq_row_spec = pl.BlockSpec(
+        (1, 1, bq, 1),
+        lambda bi, hkvi, idx, g=groups, n=nq: (
+            bi, hkvi * g + idx // n, idx % n, 0
+        ),
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, bkv, d), lambda bi, hkvi, idx: (bi, hkvi, 0, 0)
+    )
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(
+            _dqkv_fused_kernel, scale=scale, causal=causal, bq=bq,
+            bkv=bkv, s=s, s_pad=s_pad, nq=nq,
+        ),
+        grid=(b, hkv, groups * nq),
+        in_specs=[
+            gq_q_spec, kv_spec, kv_spec, gq_q_spec, gq_row_spec,
+            gq_row_spec,
+        ],
+        out_specs=[gq_q_spec, kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
+
+    if q.shape[2] == _pick_block(
+        q.shape[2], _BWD_BLOCK_KV, _BWD_BLOCK_KV_DEFAULT
+    ):
+        # Whole kv extent fits one block: take the fused one-kernel path.
+        return _fa_backward_fused_nk1(
+            q, k, v, out, lse, do, s, causal=causal, interpret=interpret
+        )
 
     b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
