@@ -122,13 +122,18 @@ def bench_materialize_ours(model_fn, *, dtype, rng_impl="rbg", report_rss=True):
 
     # Warm re-materialization of the same architecture (sweep/restart/
     # re-shard flows): the executable cache skips trace + compile, leaving
-    # fake construction + replay execution.
-    t0 = time.perf_counter()
-    model = deferred_init(model_fn)
-    arrays = materialize_module_jax(model, dtype=dtype, rng_impl=rng_impl)
-    jax.block_until_ready(list(arrays.values()))
-    warm_s = time.perf_counter() - t0
-    del model, arrays
+    # fake construction + replay execution.  Min of 3: the measurement is
+    # a fraction of a second, and single tunnel windows read 2-3× slow.
+    warm_s = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model = deferred_init(model_fn)
+        arrays = materialize_module_jax(
+            model, dtype=dtype, rng_impl=rng_impl
+        )
+        jax.block_until_ready(list(arrays.values()))
+        warm_s = min(warm_s, time.perf_counter() - t0)
+        del model, arrays
 
     out = {
         "ours_s": round(ours_s, 4),
@@ -526,6 +531,21 @@ def main():
         gen = bench_generate()
     except Exception as e:  # noqa: BLE001
         gen = {"error": f"{type(e).__name__}: {e}"}
+    # Second flash probe, minutes after the first (same compiled program,
+    # deterministic work): tunnel windows last minutes, so two temporally
+    # separated samples of the same measurement keep one bad window from
+    # defining the artifact.  min = the best observed hardware rate.
+    try:
+        flash2 = bench_flash_attention()
+    except Exception as e:  # noqa: BLE001
+        flash2 = {"error": f"{type(e).__name__}: {e}"}
+    if "error" not in flash2 and (
+        "error" in flash16k
+        or flash2["fwd_bwd_ms"] < flash16k["fwd_bwd_ms"]
+    ):
+        # Keep the first probe's error when both fail (it is the
+        # earlier, usually more informative one).
+        flash16k = flash2
     cold = bench_cold_uncached()
     # Honest cold ratios: first-ever-run (fresh process, all caches off)
     # against the same eager baselines measured above.

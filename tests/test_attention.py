@@ -84,6 +84,53 @@ class TestFlashAttention:
         for a, b_ in zip(g_ref, g_fa):
             assert jnp.allclose(a, b_, atol=5e-4)
 
+    def test_fused_bwd_matches_two_kernel_path(self):
+        """The fused nk==1 backward (training regime) and the streamed
+        two-kernel backward (long-context regime) must compute the same
+        gradients — only f32 accumulation order differs."""
+        from torchdistx_tpu.ops.pallas import flash_attention as fa
+
+        key = jax.random.PRNGKey(7)
+        b, s, hq, hkv, d = 2, 256, 4, 2, 32
+        q = jax.random.normal(key, (b, s, hq, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, hkv, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, hkv, d))
+
+        def loss(q, k, v):
+            return (
+                flash_attention(q, k, v, causal=True, interpret=True) ** 2
+            ).sum()
+
+        # Spy on the fused kernel entry so the test cannot pass vacuously
+        # if the dispatch condition ever drifts.
+        fused_calls = []
+        orig_fused = fa._fa_backward_fused_nk1
+
+        def spy(*a, **kw):
+            fused_calls.append(1)
+            return orig_fused(*a, **kw)
+
+        old = fa._BWD_BLOCK_Q, fa._BWD_BLOCK_KV
+        fa._fa_backward_fused_nk1 = spy
+        try:
+            # Defaults: bkv == s_pad, fused single-kernel path.
+            g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            assert fused_calls, "defaults no longer take the fused path"
+            n_fused = len(fused_calls)
+            # Force two kv blocks: the streamed dq + dkv kernel pair.
+            fa._BWD_BLOCK_Q, fa._BWD_BLOCK_KV = 128, 128
+            jax.clear_caches()
+            g_two = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            assert len(fused_calls) == n_fused, (
+                "128-block override still took the fused path"
+            )
+        finally:
+            fa._fa_backward_fused_nk1 = orig_fused
+            fa._BWD_BLOCK_Q, fa._BWD_BLOCK_KV = old
+            jax.clear_caches()
+        for a, b_ in zip(g_fused, g_two):
+            assert jnp.allclose(a, b_, atol=5e-5)
+
     def test_long_context_kv_streaming(self):
         # The long-context regime the kernel exists for: 8 q-blocks ×
         # 8 kv-blocks streamed through the VMEM scratch accumulators.
