@@ -233,24 +233,46 @@ for label, fn, dt in [
     del m, arrs
 print(json.dumps(out))
 """
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=900,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        lines = r.stdout.strip().splitlines()
-        if r.returncode != 0 or not lines:
-            return {
-                "error": f"subprocess exited {r.returncode}",
-                "stderr_tail": r.stderr[-2000:],
-            }
-        return _json.loads(lines[-1])
-    except Exception as e:  # noqa: BLE001 — report, don't sink the bench
-        return {"error": f"{type(e).__name__}: {e}"}
+    # Min of 2 fresh subprocesses: the cold probe runs LAST (after the
+    # big eager transfers), where a degraded tunnel window once inflated
+    # the XL number 2.2× (22.6 s vs 10.2 s re-measured minutes later).
+    # The measurement is deterministic; min = best observed cost.
+    best = None
+    err = None
+    for _ in range(2):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=900,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            lines = r.stdout.strip().splitlines()
+            if r.returncode != 0 or not lines:
+                err = err or {
+                    "error": f"subprocess exited {r.returncode}",
+                    "stderr_tail": r.stderr[-2000:],
+                }
+                continue
+            got = _json.loads(lines[-1])
+            if best is None:
+                best = got
+                best["samples"] = 1
+            else:
+                for k, v in got.items():
+                    if k in best and v < best[k]:
+                        best[k] = v
+                best["samples"] = 2
+        except Exception as e:  # noqa: BLE001 — report, don't sink bench
+            err = err or {"error": f"{type(e).__name__}: {e}"}
+    if best is not None:
+        if best["samples"] < 2 and err is not None:
+            # One sample only — say so, the min-of-2 claim didn't apply.
+            best["second_sample_error"] = err.get("error", "unknown")
+        return best
+    return err
 
 
 def bench_train_step():

@@ -131,6 +131,44 @@ class TestFlashAttention:
         for a, b_ in zip(g_fused, g_two):
             assert jnp.allclose(a, b_, atol=5e-5)
 
+    def test_fused_bwd_2048_gradients(self):
+        """S=2048 takes the fused backward with bkv = s_pad (above the
+        1024 default block — the _FUSED_BWD_MAX_KV extension); gradients
+        must match the dense reference."""
+        from torchdistx_tpu.ops.pallas import flash_attention as fa
+
+        key = jax.random.PRNGKey(9)
+        b, s, h, d = 1, 2048, 1, 32
+        q = jax.random.normal(key, (b, s, h, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, h, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, d))
+
+        fused_calls = []
+        orig = fa._fa_backward_fused_nk1
+
+        def spy(*a, **kw):
+            fused_calls.append(1)
+            return orig(*a, **kw)
+
+        fa._fa_backward_fused_nk1 = spy
+        try:
+            def loss_fa(q, k, v):
+                return (
+                    flash_attention(q, k, v, causal=True, interpret=True)
+                    ** 2
+                ).sum()
+
+            def loss_ref(q, k, v):
+                return (mha_reference(q, k, v, causal=True) ** 2).sum()
+
+            g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+            assert fused_calls, "S=2048 did not take the fused path"
+        finally:
+            fa._fa_backward_fused_nk1 = orig
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_ref, g_fa):
+            assert jnp.allclose(a, b_, atol=5e-4)
+
     def test_long_context_kv_streaming(self):
         # The long-context regime the kernel exists for: 8 q-blocks ×
         # 8 kv-blocks streamed through the VMEM scratch accumulators.

@@ -81,6 +81,11 @@ _BWD_BLOCK_Q = None
 _BWD_BLOCK_KV = None
 _BWD_BLOCK_Q_DEFAULT = 1024
 _BWD_BLOCK_KV_DEFAULT = 1024
+# Sequences up to this length take the fused one-kernel backward with the
+# whole kv extent as a single block (VMEM bound: the (bq, s_pad) f32
+# p/ds buffers — 8 MB at bq 1024, s 2048).  Beyond it, the streamed
+# two-kernel backward.
+_FUSED_BWD_MAX_KV = 2048
 _FWD_BLOCK_Q = None
 _FWD_BLOCK_KV = None
 _FWD_BLOCK_Q_DEFAULT = 1024
@@ -556,6 +561,11 @@ def _fa_backward_fused_nk1(q, k, v, out, lse, do, s, *, causal, interpret):
     groups = hq // hkv
     bq = _pick_block(s_pad, _BWD_BLOCK_Q, _BWD_BLOCK_Q_DEFAULT)
     bkv = s_pad  # single block
+    # Cap the (bq, bkv) f32 p/ds working set at the known-good 4 MB
+    # (1024² — the S=1024 training case); bq 1024 × bkv 2048 overflows
+    # VMEM server-side.
+    while bq > 128 and bq * bkv * 4 > (1024 * 1024 * 4):
+        bq //= 2
     nq = s_pad // bq
     scale = 1.0 / (d**0.5)
 
@@ -611,15 +621,17 @@ def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
-    if q.shape[2] == _pick_block(
-        q.shape[2], _BWD_BLOCK_KV, _BWD_BLOCK_KV_DEFAULT
+    b, hq, s_pad, d = q.shape
+    # Whole kv extent in one block → fused one-kernel path.  An explicit
+    # smaller kv-block override (sweeps/tests) forces the streamed pair.
+    if (_BWD_BLOCK_KV is None or _BWD_BLOCK_KV >= s_pad) and (
+        s_pad <= _FUSED_BWD_MAX_KV
+        or s_pad == _pick_block(s_pad, _BWD_BLOCK_KV, _BWD_BLOCK_KV_DEFAULT)
     ):
-        # Whole kv extent fits one block: take the fused one-kernel path.
         return _fa_backward_fused_nk1(
             q, k, v, out, lse, do, s, causal=causal, interpret=interpret
         )
 
-    b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
     groups = hq // hkv
     bq = _pick_block(s_pad, _BWD_BLOCK_Q, _BWD_BLOCK_Q_DEFAULT)
