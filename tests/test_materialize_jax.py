@@ -16,6 +16,7 @@ from torchdistx_tpu.materialize import (
 )
 from torchdistx_tpu.parallel import (
     MeshSpec,
+    combine_plans,
     fsdp_plan,
     fsdp_over,
     make_mesh,
@@ -308,6 +309,45 @@ def test_mono_fast_path_matches_per_job_path(monkeypatch):
     assert set(a2) == set(a3)
     for k in a2:
         np.testing.assert_array_equal(np.asarray(a2[k]), np.asarray(a3[k]))
+
+
+def test_bigfill_classes_2d_plan_match_tensor_path(monkeypatch):
+    """Large fills (> FILL_POOL_MAX) on a 2-D tp×fsdp mesh take the
+    big-fill class path with mixed dim-0/dim-1 shardings; values must be
+    bitwise-equal to the single-device tensor path and actually sharded."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    import torchdistx_tpu.materialize as M
+
+    monkeypatch.setenv("TDX_PROFILE_MATERIALIZE", "1")
+    config = LlamaConfig(
+        # embed/lm_head (4096×512) and the mlp mats (512×2752, sharded on
+        # dim 1 by the tp plan) are all > FILL_POOL_MAX → big-fill classes
+        # with mixed dim-0/dim-1 specs; q_proj (512²) stays pooled.
+        vocab_size=4096, hidden_size=512, intermediate_size=2752,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=64,
+    )
+    model = di.deferred_init(LlamaForCausalLM, config)
+    mesh = make_mesh(MeshSpec(fsdp=2, tp=4))
+    arrays = materialize_module_jax(
+        model, mesh=mesh, plan=combine_plans(tp_plan_llama(), fsdp_plan())
+    )
+    fakes = dict(model.named_parameters())
+    # The class path must have actually served this materialization.
+    assert any(
+        lbl == "bigfillcls" for lbl, _, _ in M.last_profile["jobs"]
+    ), M.last_profile
+    embed = arrays["model.embed_tokens.weight"]  # 4096×512 = 2.1M > pool max
+    assert not embed.sharding.is_fully_replicated
+    for name in (
+        "model.embed_tokens.weight",
+        "model.layers.0.self_attn.q_proj.weight",
+        "model.layers.1.mlp.down_proj.weight",
+    ):
+        got = np.asarray(arrays[name])
+        want = np.asarray(materialize_tensor_jax(fakes[name]))
+        np.testing.assert_array_equal(got, want, err_msg=name)
 
 
 def test_tensor_path_cross_tape_streams_distinct():

@@ -212,13 +212,9 @@ def test_1b_sharded_init_rss_and_shard_equality():
 
     On this virtual CPU mesh every "device" buffer lives in one process,
     so process peak RSS is a strict over-approximation of any real
-    host's share.  (The torch-tape path, materialize_module_jax, is
-    value-checked sharded at small scale below and in the driver dryrun;
-    at the billion scale its template groups replay inside shard_map —
-    each device generates only its own layer instances — bringing the
-    1.35B 8-device run from 45 GB to ~23 GB process RSS; the remaining
-    replication is singleton groups (embed/lm_head) and the fill bins,
-    whose transients are one PARAM per device, not the model.)"""
+    host's share.  (The torch-tape path, materialize_module_jax, has its
+    own 1.35B twin above — big-fill class programs generate every shard
+    on its owning device, 5.5 GB peak growth / 28 s measured.)"""
     import jax
     import numpy as np
 
