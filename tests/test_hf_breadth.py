@@ -29,7 +29,29 @@ def _cases():
         ViTModel,
     )
 
+    from transformers import (
+        GPT2Config,
+        GPT2LMHeadModel,
+        GPTNeoXConfig,
+        GPTNeoXForCausalLM,
+        MistralConfig,
+        MistralForCausalLM,
+    )
+
     return [
+        ("gpt2", lambda: GPT2LMHeadModel(
+            GPT2Config(n_layer=2, n_embd=64, n_head=4, vocab_size=256)
+        )),
+        ("mistral-gqa", lambda: MistralForCausalLM(
+            MistralConfig(num_hidden_layers=2, hidden_size=64,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          intermediate_size=128, vocab_size=256)
+        )),
+        ("gpt-neox", lambda: GPTNeoXForCausalLM(
+            GPTNeoXConfig(num_hidden_layers=2, hidden_size=64,
+                          num_attention_heads=4, intermediate_size=128,
+                          vocab_size=256)
+        )),
         ("bert", lambda: BertModel(
             BertConfig(num_hidden_layers=2, hidden_size=128,
                        num_attention_heads=4, intermediate_size=256)
@@ -59,12 +81,21 @@ def test_hf_family_materializes_natively(name, fn):
     arrays = materialize_module_jax(model, _fallback_torch=False)
     assert arrays, name
     # parameters + ALL buffers (state_dict would omit non-persistent
-    # buffers like BERT's position_ids, which materialize too).
+    # buffers like BERT's position_ids, which materialize too).  Buffers
+    # that are REAL at construction (0-d python-scalar constants like
+    # GPT-2's masked_bias — nothing to defer) rightly stay out of the
+    # materialized set; every parameter must be fake.
+    from torchdistx_tpu.fake import is_fake
+
+    assert all(is_fake(p) for p in model.parameters()), name
     eager = fn()
     n_eager = sum(p.numel() for p in eager.parameters()) + sum(
         b.numel() for b in eager.buffers()
     )
+    n_real_bufs = sum(
+        b.numel() for _, b in model.named_buffers() if not is_fake(b)
+    )
     n_ours = sum(int(np.prod(a.shape)) for a in arrays.values())
-    assert n_ours == n_eager, (name, n_ours, n_eager)
+    assert n_ours == n_eager - n_real_bufs, (name, n_ours, n_eager)
     for pname, a in arrays.items():
         assert np.isfinite(np.asarray(a)).all(), (name, pname)
