@@ -169,6 +169,54 @@ class TestFlashAttention:
         for a, b_ in zip(g_ref, g_fa):
             assert jnp.allclose(a, b_, atol=5e-4)
 
+    def test_fused_bwd_vmem_guard_falls_back_to_streamed(self):
+        """When even bq=128 cannot fit the (bq, s_pad) f32 p/ds working
+        set under the VMEM cap, the fused backward must hand off to the
+        streamed two-kernel path instead of overflowing — with identical
+        gradients."""
+        from torchdistx_tpu.ops.pallas import flash_attention as fa
+
+        key = jax.random.PRNGKey(11)
+        b, s, h, d = 1, 256, 2, 32
+        q = jax.random.normal(key, (b, s, h, d))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (b, s, h, d))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (b, s, h, d))
+
+        def loss(q, k, v):
+            return (
+                flash_attention(q, k, v, causal=True, interpret=True) ** 2
+            ).sum()
+
+        g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        streamed_calls = []
+        orig_streamed = fa._fa_backward_streamed
+
+        def spy(*a, **kw):
+            streamed_calls.append(kw)
+            return orig_streamed(*a, **kw)
+
+        old_cap = fa._FUSED_BWD_VMEM_CAP
+        fa._fa_backward_streamed = spy
+        try:
+            # Cap below the bq=128 working set (128·256·4 bytes): the
+            # fused path cannot whittle its way under and must fall back.
+            fa._FUSED_BWD_VMEM_CAP = 128 * s * 4 - 1
+            jax.clear_caches()
+            g_streamed = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            assert streamed_calls, "VMEM guard did not fall back"
+            # The handoff must NOT pin the streamed path to the whittled
+            # bq=128 — against _block_for-sized kv blocks its own default
+            # q block fits the cap and runs far fewer grid iterations.
+            assert "bq" not in streamed_calls[0]
+            assert streamed_calls[0]["bkv"] == fa._block_for(s)
+        finally:
+            fa._fa_backward_streamed = orig_streamed
+            fa._FUSED_BWD_VMEM_CAP = old_cap
+            jax.clear_caches()
+        for a, b_ in zip(g_fused, g_streamed):
+            assert jnp.allclose(a, b_, atol=5e-5)
+
     def test_long_context_kv_streaming(self):
         # The long-context regime the kernel exists for: 8 q-blocks ×
         # 8 kv-blocks streamed through the VMEM scratch accumulators.

@@ -1,0 +1,287 @@
+"""Telemetry subsystem: spans, counters, sinks, and the instrumented stack.
+
+The telemetry core is dependency-free (stdlib only) and must behave
+identically with and without jax present; the span/counter semantics are
+tested torch-free, the stack-instrumentation tests (materialize phase
+spans, fill-fastpath counters, ``last_profile`` back-compat) skip in a
+JAX-less environment like every other materialize test.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from torchdistx_tpu import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    """Every test starts with no sinks and zeroed registries, and leaves
+    the process-wide config the way it found it."""
+    prev = telemetry.configure(collect=False, jsonl=None)
+    telemetry.reset()
+    yield
+    telemetry.configure(**prev)
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Core span semantics
+
+
+def test_spans_nest_and_time():
+    telemetry.configure(collect=True)
+    with telemetry.span("outer", kind="test"):
+        time.sleep(0.01)
+        with telemetry.span("inner"):
+            time.sleep(0.01)
+    recs = telemetry.drain()
+    by_name = {r["name"]: r for r in recs}
+    assert set(by_name) == {"outer", "inner"}
+    inner, outer = by_name["inner"], by_name["outer"]
+    # Children record before parents (they end first), carry parentage and
+    # depth, and cannot outlast the enclosing region.
+    assert recs[0]["name"] == "inner"
+    assert inner["parent"] == "outer"
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert outer["dur_s"] >= inner["dur_s"] >= 0.01
+    assert outer["attrs"] == {"kind": "test"}
+    assert "parent" not in outer
+
+
+def test_manual_span_end_is_idempotent():
+    telemetry.configure(collect=True)
+    sp = telemetry.start_span("phase")
+    d1 = sp.end(n=3)
+    d2 = sp.end()
+    assert d1 == d2  # second end returns the fixed duration
+    recs = telemetry.drain()
+    assert len(recs) == 1  # and records exactly once
+    assert recs[0]["attrs"] == {"n": 3}
+
+
+def test_abandoned_span_does_not_corrupt_parentage():
+    # An exception that skips an end() must not make later siblings claim
+    # the dead span as parent forever.
+    telemetry.configure(collect=True)
+    telemetry.start_span("abandoned")  # never ended
+    with telemetry.span("outer"):
+        with telemetry.span("inner"):
+            pass
+    by_name = {r["name"]: r for r in telemetry.drain()}
+    assert by_name["inner"]["parent"] == "outer"
+
+
+def test_cancel_records_nothing():
+    telemetry.configure(collect=True)
+    sp = telemetry.start_span("maybe")
+    sp.cancel()
+    assert telemetry.drain() == []
+
+
+# ---------------------------------------------------------------------------
+# Counters / gauges
+
+
+def test_counters_are_thread_exact():
+    c = telemetry.counter("test.threaded")
+    n_threads, n_adds = 8, 10_000
+
+    def work():
+        for _ in range(n_adds):
+            c.add()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert telemetry.counters()["test.threaded"] == n_threads * n_adds
+
+
+def test_counter_identity_and_gauge_last_value():
+    assert telemetry.counter("test.same") is telemetry.counter("test.same")
+    telemetry.gauge("test.g").set(1.5)
+    telemetry.gauge("test.g").set(2.5)
+    assert telemetry.gauges()["test.g"] == 2.5
+    assert "test.unset" not in telemetry.gauges()
+
+
+def test_reset_keeps_bound_counters_live():
+    # Instrumented modules bind Counter objects at import; reset() must
+    # zero them without severing registry membership.
+    c = telemetry.counter("test.bound")
+    c.add(5)
+    telemetry.reset()
+    assert telemetry.counters()["test.bound"] == 0
+    c.add(2)
+    assert telemetry.counters()["test.bound"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+
+
+def test_disabled_mode_emits_nothing():
+    assert not telemetry.enabled()
+    with telemetry.span("silent"):
+        pass
+    sp = telemetry.start_span("silent2")
+    sp.end()
+    assert sp.duration is not None  # spans still time when disabled
+    snap = telemetry.snapshot()
+    assert snap["spans"] == []
+
+
+def test_jsonl_sink_roundtrips(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    telemetry.configure(jsonl=str(path))
+    with telemetry.span("a.phase", n=2):
+        pass
+    telemetry.counter("test.jsonl").add(3)
+    telemetry.gauge("test.jsonl_g").set(0.5)
+    telemetry.emit_counters()
+    telemetry.configure(jsonl=None)  # closes the handle
+
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    spans = [r for r in recs if r["type"] == "span"]
+    counters = [r for r in recs if r["type"] == "counters"]
+    assert spans and counters
+    assert spans[0]["name"] == "a.phase"
+    assert spans[0]["attrs"] == {"n": 2}
+    assert spans[0]["dur_s"] >= 0
+    assert counters[-1]["values"]["test.jsonl"] == 3
+    assert counters[-1]["gauges"]["test.jsonl_g"] == 0.5
+
+
+def test_jsonl_unserializable_attrs_degrade_to_str(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    telemetry.configure(jsonl=str(path))
+    with telemetry.span("odd", obj=object()):
+        pass
+    telemetry.configure(jsonl=None)
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert recs[0]["name"] == "odd"  # parsed, not crashed
+
+
+def test_configure_returns_previous_settings():
+    prev = telemetry.configure(collect=True)
+    assert prev["collect"] is False
+    restored = telemetry.configure(**prev)
+    assert restored["collect"] is True
+    assert not telemetry.enabled()
+
+
+# ---------------------------------------------------------------------------
+# Stack instrumentation (needs jax + torch, like the materialize tests)
+
+
+def _materialize_zoo(seed=0):
+    import torch.nn as nn
+
+    import torchdistx_tpu.deferred_init as di
+    from torchdistx_tpu.materialize import materialize_module_jax
+
+    class Zoo(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 8)
+            self.fc2 = nn.Linear(8, 4)
+            self.ln = nn.LayerNorm(4)
+
+    m = di.deferred_init(Zoo)
+    return materialize_module_jax(m, seed=seed)
+
+
+def test_materialize_emits_phase_spans_and_counters():
+    pytest.importorskip("jax")
+    telemetry.configure(collect=True)
+    c0 = telemetry.counters()
+    out = _materialize_zoo()
+    assert out
+    snap = telemetry.snapshot()
+    names = {r["name"] for r in snap["spans"]}
+    assert "materialize.module" in names
+    assert "materialize.plan" in names
+    assert "materialize.execute" in names
+    # Phase spans nest under the per-call span.
+    by_name = {r["name"]: r for r in snap["spans"]}
+    assert by_name["materialize.plan"]["parent"] == "materialize.module"
+    c1 = snap["counters"]
+    assert c1["materialize.calls"] == c0.get("materialize.calls", 0) + 1
+    assert c1["materialize.fill_fastpath_hits"] > c0.get(
+        "materialize.fill_fastpath_hits", 0
+    )
+    assert c1["tape.ops_recorded"] > c0.get("tape.ops_recorded", 0)
+
+
+def test_exec_cache_hit_counter_tracks_legacy_global():
+    pytest.importorskip("jax")
+    import torchdistx_tpu.materialize as M
+
+    _materialize_zoo(seed=1)
+    legacy0 = M.exec_cache_hits
+    t0 = telemetry.counters().get("materialize.exec_cache_hits", 0)
+    _materialize_zoo(seed=2)  # seed is traced: same programs, cache hit
+    assert M.exec_cache_hits == legacy0 + 1
+    assert telemetry.counters()["materialize.exec_cache_hits"] == t0 + 1
+
+
+def test_last_profile_backcompat_keys(monkeypatch):
+    """TDX_PROFILE_MATERIALIZE=1 must reproduce the pre-telemetry
+    ``last_profile`` shape even with every telemetry sink disabled."""
+    pytest.importorskip("jax")
+    import torchdistx_tpu.materialize as M
+
+    monkeypatch.setenv("TDX_PROFILE_MATERIALIZE", "1")
+    assert not telemetry.enabled()
+    _materialize_zoo(seed=3)
+    prof = M.last_profile
+    assert {"plan_s", "compile_s", "transfer_s", "exec_s", "jobs"} <= set(
+        prof
+    )
+    for key in ("plan_s", "compile_s", "transfer_s", "exec_s"):
+        assert isinstance(prof[key], float) and prof[key] >= 0
+    assert prof["jobs"], prof
+    for label, dur, rss in prof["jobs"]:
+        assert isinstance(label, str)
+        assert dur >= 0
+        assert rss > 0  # RSS in MB
+    # And nothing was collected: the view works without sinks.
+    assert telemetry.snapshot()["spans"] == []
+
+
+def test_counters_survive_concurrent_recording():
+    """tape.ops_recorded is exact when several threads record tapes
+    concurrently (the counter is shared; tapes are thread-local)."""
+    pytest.importorskip("jax")
+    import torch.nn as nn
+
+    import torchdistx_tpu.deferred_init as di
+
+    ops = telemetry.counter("tape.ops_recorded")
+    base = ops.value
+    di.deferred_init(nn.Linear, 8, 8)
+    per_module = ops.value - base
+    assert per_module > 0
+
+    n_threads, per_thread = 4, 5
+    before = ops.value
+    errors = []
+
+    def work():
+        try:
+            for _ in range(per_thread):
+                di.deferred_init(nn.Linear, 8, 8)
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert ops.value - before == n_threads * per_thread * per_module

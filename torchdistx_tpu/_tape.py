@@ -35,8 +35,28 @@ import torch
 import torch.utils._pytree as pytree
 
 from . import _native
+from . import telemetry as _telemetry
 
 _tls = threading.local()
+
+# Counters bound once at import (counter() lookup takes a registry lock;
+# record_op is the hot path).  Counter.add is one lock round-trip — ~2% of
+# a recorded op's cost — and exact under the concurrent recorders the
+# materializer's build pool can drive.
+_T_OPS = _telemetry.counter("tape.ops_recorded")
+_T_MUTATIONS = _telemetry.counter("tape.mutation_ops")
+_T_VIEWS = _telemetry.counter("tape.view_ops")
+# High-water mark, not current depth: tape stacks are thread-local, so a
+# last-writer-wins "current" gauge is meaningless once the materializer's
+# build pool records on several threads at once.  The peak is well-defined
+# process-wide and is the number that matters (unexpectedly deep nesting).
+_T_DEPTH_PEAK = _telemetry.gauge("tape.depth_peak")
+
+
+def _note_depth(depth: int) -> None:
+    peak = _T_DEPTH_PEAK.value
+    if peak is None or depth > peak:
+        _T_DEPTH_PEAK.set(depth)
 
 # Process-wide chronological op counter (the reference's is thread-local,
 # deferred_init.cc:671).  Global so that op_nr is unique across tapes: a
@@ -279,6 +299,7 @@ def push_tape() -> Tape:
         stack = _tls.stack = []
     stack.append(tape)
     _tls.tape = tape
+    _note_depth(len(stack))
     return tape
 
 
@@ -331,16 +352,29 @@ def arg_at_schema_pos(func, args, kwargs, pos):
     return kwargs.get(name)
 
 
-# Per-func cache of (name string, mutated schema-arg indices): schemas are
-# immutable, and str(OpOverload) + the alias_info walk cost ~25ms of a
-# GPT-2-XL record (1743 ops) when recomputed per op.
-_SCHEMA_CACHE: Dict[Any, Tuple[str, Tuple[int, ...]]] = {}
+# Per-func cache of (name string, mutated schema-arg indices, is-view):
+# schemas are immutable, and str(OpOverload) + the alias_info walk cost
+# ~25ms of a GPT-2-XL record (1743 ops) when recomputed per op.
+_SCHEMA_CACHE: Dict[Any, Tuple[str, Tuple[int, ...], bool]] = {}
 
 
-def _schema_info(func) -> Tuple[str, Tuple[int, ...]]:
+def _is_view_schema(func, mutated: Tuple[int, ...]) -> bool:
+    # Same ground truth as materialize._is_view_node: nothing written and
+    # every return aliases an input.
+    if mutated:
+        return False
+    try:
+        returns = func._schema.returns
+    except AttributeError:
+        return False
+    return bool(returns) and all(r.alias_info is not None for r in returns)
+
+
+def _schema_info(func) -> Tuple[str, Tuple[int, ...], bool]:
     info = _SCHEMA_CACHE.get(func)
     if info is None:
-        info = (str(func), tuple(_mutated_arg_indices(func)))
+        mutated = tuple(_mutated_arg_indices(func))
+        info = (str(func), mutated, _is_view_schema(func, mutated))
         _SCHEMA_CACHE[func] = info
     return info
 
@@ -450,7 +484,12 @@ def record_op(
                 preserve, (tuple(args), dict(kwargs))
             )
 
-    name, mutated = _schema_info(func)
+    name, mutated, is_view = _schema_info(func)
+    _T_OPS.add()
+    if mutated:
+        _T_MUTATIONS.add()
+    elif is_view:
+        _T_VIEWS.add()
     op = Op(
         name=name,
         func=func,

@@ -52,7 +52,6 @@ from __future__ import annotations
 import functools
 import logging
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import torch
@@ -60,6 +59,7 @@ import torch.nn as nn
 import torch.utils._pytree as pytree
 
 from . import _tape
+from . import telemetry as _telemetry
 from ._tape import OpNode, OutputRef
 from .deferred_init import _get_record, is_deferred
 from .fake import FakeTensor
@@ -534,8 +534,22 @@ def _match_fill(stack: List[OpNode], record):
     if not rw.is_whole_contiguous(rw.storage_elems) or rw.dtype != fw.dtype:
         return None
 
-    args = list(last.op.args)
-    kw = last.op.kwargs
+    scalars = _fill_scalars(kind, last)
+    if scalars is None:
+        return None
+    return kind, scalars[0], scalars[1], stack.index(last)
+
+
+def _fill_scalars(kind: str, fill_node: OpNode):
+    """The two scalar parameters of one fill node, or ``None`` when they
+    are tensor-valued (not poolable).  Used by :func:`_match_fill` on the
+    group representative AND re-derived per member at plan time
+    (:func:`_plan_fill_bins` / :func:`_plan_big_fills`): the grouping
+    signature does include scalar args, but the fast paths must not
+    silently apply the representative's init scale to every member if
+    that invariant ever loosens."""
+    args = list(fill_node.op.args)
+    kw = fill_node.op.kwargs
     if kind == "uniform":
         s0 = args[1] if len(args) > 1 else kw.get("from", 0.0)
         s1 = args[2] if len(args) > 2 else kw.get("to", 1.0)
@@ -545,7 +559,7 @@ def _match_fill(stack: List[OpNode], record):
     elif kind == "full":
         s0 = args[1] if len(args) > 1 else kw.get("value")
         s1 = 0
-        if s0 is None or isinstance(s0, (torch.Tensor, OutputRef)):
+        if s0 is None:
             return None
     else:  # zero
         s0 = s1 = 0
@@ -553,7 +567,27 @@ def _match_fill(stack: List[OpNode], record):
         s1, (torch.Tensor, OutputRef)
     ):
         return None
-    return kind, s0, s1, stack.index(last)
+    return s0, s1
+
+
+def _member_fill_scalars(kind: str, name: str, node: OpNode):
+    """Per-member fill scalars for the pooled/big-fill paths.  Signature
+    equality should make these equal the representative's; a mismatch in
+    kind or a tensor-valued scalar here means the grouping invariant
+    broke — refuse loudly rather than draw with the wrong init scale."""
+    if _FILL_FINAL_OPS.get(_packet_name(node.op.func)) != kind:
+        raise UnsupportedOpError(
+            f"fill-fastpath grouping invariant violated for '{name}': "
+            f"member fill op {node.op.name!r} does not match the group "
+            f"kind {kind!r}"
+        )
+    scalars = _fill_scalars(kind, node)
+    if scalars is None:
+        raise UnsupportedOpError(
+            f"fill-fastpath grouping invariant violated for '{name}': "
+            "member fill scalars are tensor-valued"
+        )
+    return scalars
 
 
 def _fill_fastpath_enabled() -> bool:
@@ -570,7 +604,24 @@ last_fill_fastpath_params = 0
 # {plan_s, compile_s, transfer_s, exec_s, jobs: [(label, s, rss_mb)]}.
 # Per-job numbers (blocking execute + RSS read) only under
 # TDX_PROFILE_MATERIALIZE=1 — blocking serializes dispatch.
+#
+# Back-compat view: the numbers are the durations of the telemetry spans
+# (materialize.plan/compile/transfer/execute/job — see
+# torchdistx_tpu/telemetry and docs/observability.md), assembled into the
+# legacy dict shape.  New code should read the telemetry collector.
 last_profile: Dict[str, Any] = {}
+
+# Telemetry counters, bound once (see telemetry._core.counter).  The
+# whole-call hit counter mirrors the legacy `exec_cache_hits` module
+# global; the mem/disk/compile counters resolve *which* tier served each
+# program.
+_T_CALLS = _telemetry.counter("materialize.calls")
+_T_EXEC_HITS = _telemetry.counter("materialize.exec_cache_hits")
+_T_EXEC_MEM_HITS = _telemetry.counter("materialize.exec_cache_mem_hits")
+_T_EXEC_DISK_HITS = _telemetry.counter("materialize.exec_cache_disk_hits")
+_T_COMPILES = _telemetry.counter("materialize.compiles")
+_T_FILL_FAST = _telemetry.counter("materialize.fill_fastpath_hits")
+_T_TORCH_FALLBACK = _telemetry.counter("materialize.torch_fallback_params")
 
 
 def _profile_enabled() -> bool:
@@ -630,7 +681,7 @@ def _plan_fill_bins(group_list, stacks, target_dtypes, tape_ordinals):
         if m is None:
             rest.append(g)
             continue
-        kind, s0, s1, fill_idx = m
+        kind, _, _, fill_idx = m
         rw = _MetaWindow(rec.node.out_metas[rec.index])
         if rw.numel > _FILL_POOL_MAX:
             rest.append(g)
@@ -644,6 +695,7 @@ def _plan_fill_bins(group_list, stacks, target_dtypes, tape_ordinals):
         entries = b["kinds"].setdefault(kind, [])
         for name in g["names"]:
             node = stacks[name][fill_idx]
+            m_s0, m_s1 = _member_fill_scalars(kind, name, node)
             entries.append(
                 {
                     "name": name,
@@ -651,8 +703,8 @@ def _plan_fill_bins(group_list, stacks, target_dtypes, tape_ordinals):
                     "numel": rw.numel,
                     "ord": tape_ordinals[node.base_nr],
                     "rel": node.op_nr - node.base_nr,
-                    "s0": s0,
-                    "s1": s1,
+                    "s0": m_s0,
+                    "s1": m_s1,
                     "tdt": target_dtypes[name],
                 }
             )
@@ -713,7 +765,7 @@ def _plan_big_fills(
         if m is None:
             rest.append(g)
             continue
-        kind, s0, s1, fill_idx = m
+        kind, _, _, fill_idx = m
         rw = _MetaWindow(rec.node.out_metas[rec.index])
         ddt = jnp_dtype_of(rw.dtype)
         tdt = target_dtypes[g["names"][0]]
@@ -732,6 +784,7 @@ def _plan_big_fills(
                 },
             )
             node = stacks[name][fill_idx]
+            m_s0, m_s1 = _member_fill_scalars(kind, name, node)
             sg["entries"].append(
                 {
                     "name": name,
@@ -739,8 +792,8 @@ def _plan_big_fills(
                     "numel": rw.numel,
                     "ord": tape_ordinals[node.base_nr],
                     "rel": node.op_nr - node.base_nr,
-                    "s0": s0,
-                    "s1": s1,
+                    "s0": m_s0,
+                    "s1": m_s1,
                     # target dtype is CLASS-level (sg["tdt"]): the group
                     # key above already folds in target_dtypes[name].
                 }
@@ -1013,7 +1066,7 @@ def materialize_tensor_jax(
     _check_guards_of(record.node)
     from .utils.compilation_cache import cache_everything
 
-    with cache_everything():
+    with _telemetry.span("materialize.tensor"), cache_everything():
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -1202,6 +1255,7 @@ def _exec_disk_get(key):
         import os
 
         os.utime(path)  # recency refresh: the prune evicts oldest-by-mtime
+        _T_EXEC_DISK_HITS.add()
         return loaded
     except Exception:  # noqa: BLE001 — stale/foreign blob: recompile
         return None
@@ -1263,6 +1317,8 @@ def _exec_cache_get(key):
             # cold ones.
             del _EXEC_CACHE[key]
             _EXEC_CACHE[key] = fn
+    if fn is not None:
+        _T_EXEC_MEM_HITS.add()
     return fn
 
 
@@ -1316,15 +1372,61 @@ def materialize_module_jax(
     restarts, sweeps, resharded re-inits of the same architecture — skip
     compilation entirely.
     """
+    ensure_compilation_cache()
+    global last_profile
+    last_profile = {"jobs": []}
+    _T_CALLS.add()
+    # Phase spans (telemetry): plan → compile → transfer → execute, nested
+    # under one materialize.module span.  last_profile is assembled from
+    # the spans' durations, so it works with telemetry sinks off.  The
+    # spans live in THIS frame so a raising path (guard violation, unknown
+    # strategy, UnsupportedOpError) cannot leak them onto the thread-local
+    # nesting stack or strand an open jax.profiler annotation — the call
+    # span records the error class, the never-completed plan phase drops.
+    _sp_call = _telemetry.start_span("materialize.module", strategy=strategy)
+    _sp_plan = _telemetry.start_span("materialize.plan")
+    try:
+        return _materialize_module_jax(
+            module,
+            mesh=mesh,
+            plan=plan,
+            seed=seed,
+            dtype=dtype,
+            rng_impl=rng_impl,
+            strategy=strategy,
+            _fallback_torch=_fallback_torch,
+            _sp_call=_sp_call,
+            _sp_plan=_sp_plan,
+        )
+    except BaseException as e:
+        if _sp_plan.duration is None:
+            _sp_plan.cancel()
+        if _sp_call.duration is None:
+            _sp_call.end(error=type(e).__name__)
+        raise
+
+
+def _materialize_module_jax(
+    module: nn.Module,
+    *,
+    mesh,
+    plan,
+    seed,
+    dtype,
+    rng_impl,
+    strategy,
+    _fallback_torch,
+    _sp_call,
+    _sp_plan,
+) -> Dict[str, Any]:
     import jax
 
-    ensure_compilation_cache()
-    global exec_cache_hits, last_profile
-    last_profile = {"jobs": []}
-    _prof_t0 = time.perf_counter()
+    global exec_cache_hits
 
     named = _named_fakes(module)
     if not named:
+        _sp_plan.cancel()
+        _sp_call.end(n_params=0)
         return {}
 
     # Eager guard validation (torch-side, can't run under trace).
@@ -1388,6 +1490,8 @@ def materialize_module_jax(
         last_fill_fastpath_params = sum(
             len(_bin_names(b)) for b in bin_list
         )
+        if last_fill_fastpath_params:
+            _T_FILL_FAST.add(last_fill_fastpath_params)
 
         # Instance-distribution axis for shard_map'd generation: the
         # largest mesh axis (shared by the big-fill job and the template
@@ -1855,20 +1959,27 @@ def materialize_module_jax(
             if mfn is not None:
                 # Phase stamps land here; the downstream stamps are
                 # setdefault so the mono timings aren't overwritten.
-                last_profile["plan_s"] = time.perf_counter() - _prof_t0
+                last_profile["plan_s"] = _sp_plan.end()
                 last_profile["compile_s"] = 0.0
-                _tm = time.perf_counter()
+                _sp = _telemetry.start_span(
+                    "materialize.transfer", job="mono"
+                )
                 buf_dev = jax.device_put(packed_m)
-                last_profile["transfer_s"] = time.perf_counter() - _tm
-                _tm = time.perf_counter()
+                last_profile["transfer_s"] = _sp.end()
+                _sp = _telemetry.start_span(
+                    "materialize.execute", job="mono"
+                )
                 results.update(mfn(base_key, *buf_dev))
                 if _profile_enabled():
                     jax.block_until_ready(list(results.values()))
+                    rss = _rss_mb_now()
+                    _sp.end(rss_mb=rss)
                     last_profile["jobs"].append(
-                        ("mono", time.perf_counter() - _tm, _rss_mb_now())
+                        ("mono", _sp.duration, rss)
                     )
-                last_profile["exec_s"] = time.perf_counter() - _tm
+                last_profile["exec_s"] = _sp.end()
                 exec_cache_hits += 1
+                _T_EXEC_HITS.add()
                 # Everything executed; the sections below see empty work.
                 jobs, class_jobs, shadow_jobs = [], [], []
             else:
@@ -1876,10 +1987,7 @@ def materialize_module_jax(
                     (mono_key, _mono_fn, (base_key, *packed_m), None)
                 )
 
-        last_profile.setdefault(
-            "plan_s", time.perf_counter() - _prof_t0
-        )
-        _prof_t0 = time.perf_counter()
+        last_profile.setdefault("plan_s", _sp_plan.end())
         compiled: Dict[int, Any] = {}
         misses = []
         n_exec = len(jobs) + len(class_jobs)
@@ -1901,6 +2009,9 @@ def materialize_module_jax(
         misses += range(n_exec, len(build_list))
         had_compiles = False
         if misses:
+            _sp_compile = _telemetry.start_span(
+                "materialize.compile", n_programs=len(misses)
+            )
 
             def _build(i):
                 nonlocal had_compiles
@@ -1918,6 +2029,7 @@ def materialize_module_jax(
                     else jax.jit(fn)
                 )
                 cfn = jfn.lower(*args).compile()
+                _T_COMPILES.add()
                 if key is not None:
                     _exec_cache_put(key, cfn)
                 return cfn
@@ -1935,11 +2047,9 @@ def materialize_module_jax(
                             misses, pool.map(_build, misses)
                         ):
                             compiled[i] = cfn
+            last_profile.setdefault("compile_s", _sp_compile.end())
 
-        last_profile.setdefault(
-            "compile_s", time.perf_counter() - _prof_t0
-        )
-        _prof_t0 = time.perf_counter()
+        last_profile.setdefault("compile_s", 0.0)
         # Ship every job's host argument leaves in ONE transfer per dtype:
         # on a tunneled backend each host→device put is a full RPC (~40 ms
         # measured), and the ~70 tiny index/fill arrays (a few KB total!)
@@ -1955,6 +2065,7 @@ def materialize_module_jax(
         # against mesh-lowered programs is version-dependent (advisor r4).
         all_args = [args for _, _, args, _ in jobs]
         if jobs and mesh is None:
+            _sp_transfer = _telemetry.start_span("materialize.transfer")
             leaves, treedef = jax.tree.flatten(all_args)
             by_dtype, order, layout, packed = _pack_host_leaves(leaves)
             if packed:
@@ -1983,27 +2094,42 @@ def materialize_module_jax(
                     for i in by_dtype[dt]:
                         leaves[i] = next(unpacked)
             all_args = jax.tree.unflatten(treedef, leaves)
-        last_profile.setdefault(
-            "transfer_s", time.perf_counter() - _prof_t0
+            last_profile.setdefault("transfer_s", _sp_transfer.end())
+        last_profile.setdefault("transfer_s", 0.0)
+        _sp_exec = (
+            _telemetry.start_span(
+                "materialize.execute",
+                n_jobs=len(jobs),
+                n_classes=len(big_list),
+            )
+            if jobs or big_list
+            else None
         )
-        _prof_t0 = time.perf_counter()
         _prof = _profile_enabled()
         for i in range(len(jobs)):
-            _tj = time.perf_counter()
-            res_i = compiled[i](*all_args[i])
             if _prof:
-                jax.block_until_ready(list(res_i.values()))
                 key = jobs[i][0]
                 label = (
                     key[0] if isinstance(key, tuple) and key else "rest"
                 )
-                last_profile["jobs"].append(
-                    (label, time.perf_counter() - _tj, _rss_mb_now())
+                _spj = _telemetry.start_span(
+                    "materialize.job", label=label
                 )
+                res_i = compiled[i](*all_args[i])
+                jax.block_until_ready(list(res_i.values()))
+                rss = _rss_mb_now()
+                _spj.end(rss_mb=rss)
+                last_profile["jobs"].append((label, _spj.duration, rss))
+            else:
+                res_i = compiled[i](*all_args[i])
             results.update(res_i)
         # Big-fill classes: one dispatch per instance of the class's
         # compiled program (dispatches are cheap; compiles were O(classes)).
-        _tbf = time.perf_counter()
+        _spb = (
+            _telemetry.start_span("materialize.job", label="bigfillcls")
+            if _prof and big_list
+            else None
+        )
         off = 0
         for j, sg in enumerate(big_list):
             cfn = compiled[len(jobs) + j]
@@ -2011,18 +2137,21 @@ def materialize_module_jax(
             for t, e in enumerate(sg["entries"]):
                 results[e["name"]] = cfn(keys_rep[off + t], s0r[t], s1r[t])
             off += len(sg["entries"])
-        if _prof and big_list:
+        if _spb is not None:
             jax.block_until_ready(
                 [results[e["name"]] for sg in big_list for e in sg["entries"]]
             )
+            rss = _rss_mb_now()
+            _spb.end(rss_mb=rss)
             last_profile["jobs"].append(
-                ("bigfillcls", time.perf_counter() - _tbf, _rss_mb_now())
+                ("bigfillcls", _spb.duration, rss)
             )
         last_profile.setdefault(
-            "exec_s", time.perf_counter() - _prof_t0
+            "exec_s", _sp_exec.end() if _sp_exec is not None else 0.0
         )
         if (jobs or class_jobs) and not had_compiles:
             exec_cache_hits += 1
+            _T_EXEC_HITS.add()
 
     # Torch fallback for ops with no lowering: replay on host, transfer with
     # the planned sharding.  Per-tensor, so peak host RAM ≈ largest param.
@@ -2033,17 +2162,36 @@ def materialize_module_jax(
             )
         from .deferred_init import materialize_tensor
 
-        for name, fake in unsupported:
-            real = materialize_tensor(fake, device="cpu")
-            arr = jax.numpy.asarray(
-                real.detach().cpu().numpy(), dtype=target_dtypes[name]
-            )
-            if mesh is not None:
-                from jax.sharding import NamedSharding
-
-                arr = jax.device_put(
-                    arr,
-                    NamedSharding(mesh, _resolve_spec(plan, name, fake, mesh)),
+        if _sp_plan.duration is None:
+            # No jax-path planning closed the phase (every param is
+            # unsupported): drop it BEFORE the fallback span starts, so
+            # the fallback parents on materialize.module rather than on a
+            # plan span the trace will never contain.
+            _sp_plan.cancel()
+        _T_TORCH_FALLBACK.add(len(unsupported))
+        with _telemetry.span(
+            "materialize.torch_fallback", n_params=len(unsupported)
+        ):
+            for name, fake in unsupported:
+                real = materialize_tensor(fake, device="cpu")
+                arr = jax.numpy.asarray(
+                    real.detach().cpu().numpy(), dtype=target_dtypes[name]
                 )
-            results[name] = arr
+                if mesh is not None:
+                    from jax.sharding import NamedSharding
+
+                    arr = jax.device_put(
+                        arr,
+                        NamedSharding(
+                            mesh, _resolve_spec(plan, name, fake, mesh)
+                        ),
+                    )
+                results[name] = arr
+    if _sp_plan.duration is None:
+        # No jax-path planning happened (every param unsupported): the
+        # plan phase never closed — drop it rather than record the whole
+        # call under the wrong name.
+        _sp_plan.cancel()
+    _sp_call.end(n_params=len(results))
+    _telemetry.emit_counters()
     return results

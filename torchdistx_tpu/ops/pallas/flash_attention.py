@@ -86,10 +86,23 @@ _BWD_BLOCK_KV_DEFAULT = 1024
 # p/ds buffers — 8 MB at bq 1024, s 2048).  Beyond it, the streamed
 # two-kernel backward.
 _FUSED_BWD_MAX_KV = 2048
+# Known-good f32 working-set budget for one (bq, bkv) p/ds pair in the
+# fused backward (1024² — the S=1024 training case); bq 1024 × bkv 2048
+# overflows VMEM server-side.  The fused path halves bq down to 128 to
+# stay under this, and falls back to the streamed two-kernel backward
+# when even bq=128 cannot fit (bkv = s_pad > 8192).
+_FUSED_BWD_VMEM_CAP = 1024 * 1024 * 4
 _FWD_BLOCK_Q = None
 _FWD_BLOCK_KV = None
 _FWD_BLOCK_Q_DEFAULT = 1024
 _FWD_BLOCK_KV_DEFAULT = 1024
+
+
+def _compiler_params(pltpu, **kw):
+    """``pltpu.CompilerParams``, falling back to the pre-rename
+    ``TPUCompilerParams`` (jax < 0.6) — same kwargs either way."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
 
 
 def _pick_block(s_pad: int, override, default) -> int:
@@ -293,7 +306,8 @@ def _fa_forward_padded(q, k, v, s, *, causal: bool, interpret: bool):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -561,11 +575,21 @@ def _fa_backward_fused_nk1(q, k, v, out, lse, do, s, *, causal, interpret):
     groups = hq // hkv
     bq = _pick_block(s_pad, _BWD_BLOCK_Q, _BWD_BLOCK_Q_DEFAULT)
     bkv = s_pad  # single block
-    # Cap the (bq, bkv) f32 p/ds working set at the known-good 4 MB
-    # (1024² — the S=1024 training case); bq 1024 × bkv 2048 overflows
-    # VMEM server-side.
-    while bq > 128 and bq * bkv * 4 > (1024 * 1024 * 4):
+    # Cap the (bq, bkv) f32 p/ds working set (_FUSED_BWD_VMEM_CAP) by
+    # halving bq; below 128 rows the MXU tiles go partial, so once bq
+    # bottoms out there the single-block premise itself has failed —
+    # stream kv through a grid axis instead of holding it whole.
+    while bq > 128 and bq * bkv * 4 > _FUSED_BWD_VMEM_CAP:
         bq //= 2
+    if bq * bkv * 4 > _FUSED_BWD_VMEM_CAP:
+        # Don't hand the streamed path our whittled bq: its kv blocks are
+        # _block_for-sized, not the whole extent, so its own default q
+        # block (the known-good 1024² working set) fits the cap fine —
+        # a 128-row handoff would just run 8× more dq grid iterations.
+        return _fa_backward_streamed(
+            q, k, v, out, lse, do, s, causal=causal, interpret=interpret,
+            bkv=_block_for(s_pad),
+        )
     nq = s_pad // bq
     scale = 1.0 / (d**0.5)
 
@@ -609,7 +633,8 @@ def _fa_backward_fused_nk1(q, k, v, out, lse, do, s, *, causal, interpret):
             pltpu.VMEM((bkv, d), jnp.float32),
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -618,10 +643,7 @@ def _fa_backward_fused_nk1(q, k, v, out, lse, do, s, *, causal, interpret):
 
 
 def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
-    import jax.experimental.pallas as pl
-    import jax.experimental.pallas.tpu as pltpu
-
-    b, hq, s_pad, d = q.shape
+    s_pad = q.shape[2]
     # Whole kv extent in one block → fused one-kernel path.  An explicit
     # smaller kv-block override (sweeps/tests) forces the streamed pair.
     if (_BWD_BLOCK_KV is None or _BWD_BLOCK_KV >= s_pad) and (
@@ -631,11 +653,28 @@ def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
         return _fa_backward_fused_nk1(
             q, k, v, out, lse, do, s, causal=causal, interpret=interpret
         )
+    return _fa_backward_streamed(
+        q, k, v, out, lse, do, s, causal=causal, interpret=interpret
+    )
 
+
+def _fa_backward_streamed(
+    q, k, v, out, lse, do, s, *, causal, interpret, bq=None, bkv=None
+):
+    """The streamed two-kernel backward (dq kernel + dk/dv kernel), kv as
+    a grid axis.  ``bq``/``bkv`` are normally derived from the sweep
+    overrides; the fused path passes explicit VMEM-safe blocks when it
+    falls back here."""
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
     groups = hq // hkv
-    bq = _pick_block(s_pad, _BWD_BLOCK_Q, _BWD_BLOCK_Q_DEFAULT)
-    bkv = _pick_block(s_pad, _BWD_BLOCK_KV, _BWD_BLOCK_KV_DEFAULT)
+    if bq is None:
+        bq = _pick_block(s_pad, _BWD_BLOCK_Q, _BWD_BLOCK_Q_DEFAULT)
+    if bkv is None:
+        bkv = _pick_block(s_pad, _BWD_BLOCK_KV, _BWD_BLOCK_KV_DEFAULT)
     nq, nk = s_pad // bq, s_pad // bkv
     scale = 1.0 / (d**0.5)
 
@@ -664,7 +703,8 @@ def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -707,7 +747,8 @@ def _fa_backward(q, k, v, out, lse, do, s, *, causal, interpret):
             pltpu.VMEM((bkv, d), jnp.float32),
             pltpu.VMEM((bkv, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
+            pltpu,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -104,6 +104,21 @@ def initialize(
     return world_info()
 
 
+def _degenerate_cpu_slices(devices) -> bool:
+    """True when every device reports the SAME ``slice_index`` on a CPU
+    backend — metadata that carries no DCN structure (multi-process CPU
+    backends report slice 0 everywhere).  On real accelerators a uniform
+    slice_index is genuine single-slice topology and must NOT be treated
+    as degenerate, so a caller requesting more DCN granules than the
+    topology has fails loudly instead of silently relabeling an ICI
+    boundary as DCN.  Shared by :func:`_slice_granules` and
+    :func:`make_hybrid_mesh` so the two paths can never disagree."""
+    slice_keys = {getattr(d, "slice_index", None) for d in devices}
+    return len(slice_keys) == 1 and all(
+        getattr(d, "platform", None) == "cpu" for d in devices
+    )
+
+
 def _slice_granules(devices) -> list:
     """Group devices into DCN granules (pod slices / hosts).
 
@@ -111,15 +126,21 @@ def _slice_granules(devices) -> list:
     ``process_index`` (one granule per host) and finally to a single
     granule.  Granule order is the sorted key order, so every process
     builds the identical mesh.
+
+    Reachability from :func:`make_hybrid_mesh`: only the degenerate-CPU
+    and missing-``slice_index`` branches arrive here (real slice metadata
+    takes ``create_hybrid_device_mesh`` up there), so the slice-keyed
+    branch below serves direct callers and tests.
     """
-    # All-or-nothing key domain (mirrors make_hybrid_mesh): mixing
+    # All-or-nothing key domain, with the SAME degeneracy rule as
+    # make_hybrid_mesh (:func:`_degenerate_cpu_slices`): mixing
     # slice_index with process_index fallbacks would interleave unrelated
-    # id spaces in the sorted granule order.  Degenerate metadata — every
-    # device reporting the SAME slice_index, as multi-process CPU backends
-    # do (slice 0 everywhere) — carries no DCN structure; fall through to
-    # process_index (one granule per host).
+    # id spaces in the sorted granule order; degenerate CPU metadata falls
+    # through to process_index (one granule per host).
     slice_keys = [getattr(d, "slice_index", None) for d in devices]
-    if all(k is not None for k in slice_keys) and len(set(slice_keys)) > 1:
+    if all(k is not None for k in slice_keys) and not _degenerate_cpu_slices(
+        devices
+    ):
         keys = slice_keys
     else:
         keys = [getattr(d, "process_index", 0) for d in devices]
@@ -179,16 +200,12 @@ def make_hybrid_mesh(
     from jax.sharding import Mesh
 
     slice_ids = {getattr(d, "slice_index", None) for d in devices}
-    # Multi-process CPU backends report slice 0 on EVERY device — metadata
-    # that carries no DCN structure; those take the granule fallback below
-    # (grouped by process_index).  Real accelerators keep the topology-
-    # aware path even with one slice, so a genuine mismatch (dcn extent 2
-    # on a single-slice pod) still raises instead of silently relabeling
-    # an ICI boundary as DCN.
-    degenerate_cpu = len(slice_ids) == 1 and all(
-        getattr(d, "platform", None) == "cpu" for d in devices
-    )
-    if None not in slice_ids and not degenerate_cpu:
+    # Degenerate CPU slice metadata (see _degenerate_cpu_slices) takes the
+    # granule fallback below (grouped by process_index).  Real accelerators
+    # keep the topology-aware path even with one slice, so a genuine
+    # mismatch (dcn extent 2 on a single-slice pod) still raises instead
+    # of silently relabeling an ICI boundary as DCN.
+    if None not in slice_ids and not _degenerate_cpu_slices(devices):
         # Real slice metadata (TPU pods): use jax's slice- and
         # ICI-topology-aware placement, and let genuine topology errors
         # (unmappable ici factors, wrong dcn extent) propagate instead of

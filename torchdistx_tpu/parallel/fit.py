@@ -8,13 +8,43 @@ re-shard — resume from the latest checkpoint, *including onto a different
 mesh*: restore targets are abstract arrays carrying the new mesh's
 shardings, so orbax reads each shard straight to its new owning device
 (no full-tensor host round-trip; see utils/checkpoint.py).
+
+Telemetry: every step runs under a ``train.step`` span (with
+``TDX_TELEMETRY_JAX=1`` that is a ``StepTraceAnnotation``, so the XLA
+profiler's step view works out of the box), and the loop derives
+``steps_per_s`` / ``tokens_per_s`` / ``mfu`` throughput, publishing them as
+gauges AND merging them into the metrics dict handed to ``on_metrics``.
+Throughput is wall time between successive ``step_fn`` returns: dispatch is
+async, so the first measured steps read fast until device backpressure
+aligns dispatch with execution — steady-state values are the meaningful
+ones (the first step, which carries compilation, is skipped entirely).
 """
 
 from __future__ import annotations
 
+import math
+import time
 from typing import Any, Callable, Iterable, Optional
 
+from .. import telemetry as _telemetry
+
 __all__ = ["fit"]
+
+_T_STEPS = _telemetry.counter("train.steps")
+_T_STEPS_S = _telemetry.gauge("train.steps_per_s")
+_T_TOKENS_S = _telemetry.gauge("train.tokens_per_s")
+_T_MFU = _telemetry.gauge("train.mfu")
+
+
+def _batch_tokens(batch) -> Optional[int]:
+    """Token count of one batch: the ``tokens`` leaf's element count (the
+    ``{"tokens", "targets"}`` convention of make_train_step)."""
+    if not isinstance(batch, dict):
+        return None
+    shape = getattr(batch.get("tokens"), "shape", None)
+    if not shape:
+        return None
+    return int(math.prod(shape))
 
 
 def fit(
@@ -27,6 +57,9 @@ def fit(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 100,
     on_metrics: Optional[Callable[[int, Any], None]] = None,
+    tokens_per_batch: Optional[int] = None,
+    flops_per_step: Optional[float] = None,
+    peak_flops: Optional[float] = None,
 ):
     """Run up to ``n_steps`` optimizer steps, resuming from checkpoints.
 
@@ -36,6 +69,14 @@ def fit(
     steps already completed by a restored checkpoint are skipped by
     *advancing* the iterator, so a deterministic data stream stays aligned
     with the optimizer step count after resume.
+
+    Throughput telemetry (see module docstring): ``steps_per_s`` is always
+    derived; ``tokens_per_s`` additionally needs the batch token count
+    (``tokens_per_batch``, or auto-detected from a ``{"tokens": ...}``
+    batch dict); ``mfu`` additionally needs ``flops_per_step`` (model
+    FLOPs per optimizer step) and ``peak_flops`` (the chip's peak, in
+    FLOP/s — see bench.py's per-device-kind table).  When ``metrics`` is a
+    dict, the derived values are merged in before ``on_metrics`` sees it.
 
     Returns ``(state, last_metrics)``.
     """
@@ -66,13 +107,33 @@ def fit(
         return state, metrics
     try:
         it = iter(batches)
+        t_prev = None
         for i, batch in enumerate(it):
             if i >= n_steps:
                 break
             if i < start:
                 continue  # replay the data stream up to the resume point
-            state, metrics = step_fn(state, batch)
             done = i + 1
+            with _telemetry.span("train.step", step=done):
+                state, metrics = step_fn(state, batch)
+            _T_STEPS.add()
+            now = time.perf_counter()
+            if t_prev is not None and now > t_prev:
+                steps_per_s = 1.0 / (now - t_prev)
+                _T_STEPS_S.set(steps_per_s)
+                derived = {"steps_per_s": steps_per_s}
+                n_tok = tokens_per_batch or _batch_tokens(batch)
+                if n_tok:
+                    tokens_per_s = n_tok * steps_per_s
+                    _T_TOKENS_S.set(tokens_per_s)
+                    derived["tokens_per_s"] = tokens_per_s
+                if flops_per_step and peak_flops:
+                    mfu = flops_per_step * steps_per_s / peak_flops
+                    _T_MFU.set(mfu)
+                    derived["mfu"] = mfu
+                if isinstance(metrics, dict):
+                    metrics = {**metrics, **derived}
+            t_prev = now
             if on_metrics is not None:
                 on_metrics(done, metrics)
             if ckptr is not None and (

@@ -44,6 +44,17 @@ __all__ = ["ring_attention"]
 _NEG_INF = float("-inf")
 
 
+def _axis_size(axis: str) -> int:
+    """Static size of the mapped axis — ``jax.lax.axis_size`` on current
+    jax; jax < 0.6 exposes it only as the axis-env frame."""
+    ax = getattr(jax.lax, "axis_size", None)
+    if ax is not None:
+        return ax(axis)
+    from jax._src.core import axis_frame
+
+    return axis_frame(axis)
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     try:
         return jax.shard_map(
@@ -106,7 +117,7 @@ def _merge(acc, blk):
 
 def _ring_body(q, k, v, *, axis: str, causal: bool):
     """Per-device body under shard_map: local blocks in, local out."""
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, sl, hq, d = q.shape
     q_off = idx * sl
@@ -165,7 +176,7 @@ def _zigzag_ring_body(q, k, v, *, axis: str):
     on every device — the causal load balance the contiguous assignment
     lacks.
     """
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = jax.lax.axis_index(axis)
     b, sl, hq, d = q.shape
     h = sl // 2

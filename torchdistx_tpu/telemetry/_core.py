@@ -1,0 +1,497 @@
+"""Telemetry core: spans, counters, gauges, and sinks.
+
+Dependency-free by design (stdlib only, no torch/jax imports at module
+level): `_tape.py`'s per-op record path and `materialize.py`'s phase
+boundaries bind counters/spans at import time, so this module must be
+importable before either torch or jax and must cost nothing when disabled.
+
+Three primitives:
+
+* :func:`span` / :func:`start_span` — nested, thread-aware timed regions.
+  A span *always* measures (two ``perf_counter`` calls — this is how
+  ``materialize.last_profile`` keeps working with telemetry off) but only
+  *records* when a sink is active: no record dict, no string formatting,
+  no JSON when disabled.
+* :func:`counter` / :func:`gauge` — named registries of monotonic counts
+  and last-value gauges.  Counters always accumulate (they are the
+  process-introspection layer, like ``materialize.exec_cache_hits``);
+  each carries its own lock so concurrent materialization build pools and
+  multi-threaded recorders count exactly.
+* sinks — the in-memory collector (bounded deque, queryable via
+  :func:`snapshot`/:func:`drain`), a JSON-lines exporter
+  (``TDX_TELEMETRY=/path/trace.jsonl`` or ``configure(jsonl=...)``), and
+  optional ``jax.profiler`` annotation pass-through
+  (``TDX_TELEMETRY_JAX=1``) so spans appear in XLA profiler traces.
+
+Environment (read once, at first telemetry use; :func:`configure` wins):
+
+* ``TDX_TELEMETRY=/path/trace.jsonl`` — enable the JSONL exporter AND the
+  in-memory collector.
+* ``TDX_TELEMETRY_JAX=1`` — wrap spans in ``jax.profiler``
+  ``TraceAnnotation`` (or ``StepTraceAnnotation`` when the span carries a
+  ``step`` attribute).
+* ``TDX_NO_TELEMETRY=1`` — kill switch: no sink activates regardless of
+  the above.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Span",
+    "configure",
+    "counter",
+    "counters",
+    "drain",
+    "emit_counters",
+    "enabled",
+    "gauge",
+    "gauges",
+    "reset",
+    "snapshot",
+    "span",
+    "start_span",
+]
+
+_logger = logging.getLogger(__name__)
+
+_REG_LOCK = threading.Lock()
+_tls = threading.local()
+
+_DEFAULT_MAX_SPANS = 4096
+
+
+class Counter:
+    """Monotonic named count.  ``add`` is thread-exact (own lock) and, when
+    no sink is ever read, costs one lock round-trip + an int add."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def __repr__(self):
+        return f"Counter({self.name}={self._value})"
+
+
+class Gauge:
+    """Last-value named gauge (floats or ints)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Any = None
+
+    def set(self, value) -> None:
+        self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+    def __repr__(self):
+        return f"Gauge({self.name}={self._value})"
+
+
+class _State:
+    """Process-wide telemetry configuration + sinks (lazily env-seeded)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.initialized = False
+        self.collect = False
+        self.jsonl_path: Optional[str] = None
+        self.jax_annotations = False
+        self.max_spans = _DEFAULT_MAX_SPANS
+        self.spans: deque = deque(maxlen=_DEFAULT_MAX_SPANS)
+        self.jsonl_file = None
+        self.jsonl_lock = threading.Lock()
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+
+    # -- configuration ------------------------------------------------------
+
+    def ensure_init(self) -> None:
+        if self.initialized:
+            return
+        with self.lock:
+            if self.initialized:
+                return
+            self.initialized = True
+            if os.environ.get("TDX_NO_TELEMETRY"):
+                return
+            path = os.environ.get("TDX_TELEMETRY")
+            if path:
+                self.jsonl_path = path
+                self.collect = True
+            if os.environ.get("TDX_TELEMETRY_JAX"):
+                self.jax_annotations = True
+
+    def jsonl_handle(self):
+        """Lazily opened append-mode handle; a failed open disables the
+        sink (telemetry must never fail the instrumented operation)."""
+        if self.jsonl_path is None:
+            return None
+        if self.jsonl_file is None:
+            with self.jsonl_lock:
+                if self.jsonl_file is None and self.jsonl_path is not None:
+                    try:
+                        self.jsonl_file = open(  # noqa: SIM115 — held open
+                            self.jsonl_path, "a", encoding="utf-8"
+                        )
+                    except OSError as e:
+                        _logger.warning(
+                            "telemetry: cannot open %s (%s); JSONL sink "
+                            "disabled", self.jsonl_path, e,
+                        )
+                        self.jsonl_path = None
+                        return None
+        return self.jsonl_file
+
+    def close_jsonl(self) -> None:
+        with self.jsonl_lock:
+            if self.jsonl_file is not None:
+                try:
+                    self.jsonl_file.close()
+                except OSError:
+                    pass
+                self.jsonl_file = None
+
+    # -- emission -----------------------------------------------------------
+
+    def active(self) -> bool:
+        return self.collect or self.jsonl_path is not None
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        if self.collect:
+            self.spans.append(rec)
+        self.write_jsonl(rec)
+
+    def write_jsonl(self, rec: Dict[str, Any]) -> None:
+        f = self.jsonl_handle()
+        if f is None:
+            return
+        try:
+            line = json.dumps(rec, default=str)
+        except (TypeError, ValueError):
+            line = json.dumps({k: str(v) for k, v in rec.items()})
+        with self.jsonl_lock:
+            try:
+                f.write(line + "\n")
+                f.flush()
+            except (OSError, ValueError):
+                # Closed/full file: drop the sink, keep the program.
+                self.jsonl_path = None
+
+
+_state = _State()
+
+
+def _span_stack() -> List["Span"]:
+    stack = getattr(_tls, "spans", None)
+    if stack is None:
+        stack = _tls.spans = []
+    return stack
+
+
+class Span:
+    """One timed region.  Use as a context manager (``with span(...)``) or
+    via :func:`start_span` + :meth:`end` when the region doesn't nest as a
+    ``with`` block (materialize's phase boundaries).
+
+    ``end`` is idempotent — the first call fixes the duration; later calls
+    return it unchanged.  The thread-local nesting stack is popped by
+    identity and tolerates imbalance (an exception that skips an ``end``
+    cannot corrupt later spans' parentage).
+    """
+
+    __slots__ = (
+        "name", "attrs", "t0", "ts", "duration", "parent", "depth",
+        "_annotation", "_recorded",
+    )
+
+    def __init__(self, name: str, attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.ts = 0.0
+        self.duration: Optional[float] = None
+        self.parent: Optional[str] = None
+        self.depth = 0
+        self._annotation = None
+        self._recorded = False
+
+    def start(self) -> "Span":
+        stack = _span_stack()
+        if len(stack) > 128:
+            # Safety valve: spans abandoned by exceptions (an instrumented
+            # operation that raised between start and end) accumulate here;
+            # genuine nesting never goes this deep.  Reset rather than let
+            # parent attribution degrade without bound.
+            for sp in stack:
+                sp._close_annotation()
+            stack.clear()
+        if stack:
+            self.parent = stack[-1].name
+            self.depth = len(stack)
+        stack.append(self)
+        if _state.jax_annotations:
+            self._enter_annotation()
+        self.ts = time.time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def end(self, **attrs) -> float:
+        if self.duration is None:
+            self.duration = time.perf_counter() - self.t0
+        if attrs:
+            self.attrs = {**(self.attrs or {}), **attrs}
+        stack = getattr(_tls, "spans", None)
+        if stack and self in stack:
+            # Identity pop, tolerating spans above us abandoned by
+            # exceptions — but their profiler annotations must still exit
+            # (innermost first, before ours) or the thread's TraceMe stack
+            # goes permanently unbalanced.
+            while stack:
+                top = stack.pop()
+                if top is self:
+                    break
+                top._close_annotation()
+        self._close_annotation()
+        if not self._recorded and _state.active():
+            self._recorded = True
+            rec = {
+                "type": "span",
+                "name": self.name,
+                "ts": self.ts,
+                "dur_s": self.duration,
+                "thread": threading.get_ident(),
+                "depth": self.depth,
+            }
+            if self.parent is not None:
+                rec["parent"] = self.parent
+            if self.attrs:
+                rec["attrs"] = self.attrs
+            _state.record(rec)
+        return self.duration
+
+    def cancel(self) -> None:
+        """Close the span without recording it (a phase that turned out
+        not to apply).  Timing state is finalized; sinks see nothing."""
+        self._recorded = True
+        self.end()
+
+    def _close_annotation(self) -> None:
+        if self._annotation is not None:
+            try:
+                self._annotation.__exit__(None, None, None)
+            except Exception:  # noqa: BLE001 — profiler teardown best-effort
+                pass
+            self._annotation = None
+
+    def _enter_annotation(self) -> None:
+        # jax.profiler pass-through: spans show up in XLA profiler traces
+        # (TensorBoard / xprof).  A `step` attribute selects the step-level
+        # annotation the profiler's step view keys on.
+        try:
+            from jax.profiler import StepTraceAnnotation, TraceAnnotation
+
+            attrs = self.attrs or {}
+            if "step" in attrs:
+                self._annotation = StepTraceAnnotation(
+                    self.name, step_num=attrs["step"]
+                )
+            else:
+                self._annotation = TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:  # noqa: BLE001 — no jax / old jax: spans still time
+            self._annotation = None
+
+    def __enter__(self) -> "Span":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Public API
+
+
+def configure(
+    *,
+    jsonl: Optional[str] = "__unset__",
+    collect: Optional[bool] = None,
+    jax_annotations: Optional[bool] = None,
+    max_spans: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Set telemetry sinks programmatically (overrides the env defaults).
+
+    Returns the PREVIOUS settings as a kwargs dict, so a caller (tests,
+    a bench scope) can restore them: ``prev = configure(collect=True)``
+    ... ``configure(**prev)``.
+    """
+    _state.ensure_init()
+    with _state.lock:
+        prev = {
+            "jsonl": _state.jsonl_path,
+            "collect": _state.collect,
+            "jax_annotations": _state.jax_annotations,
+            "max_spans": _state.max_spans,
+        }
+        if jsonl != "__unset__":
+            if jsonl != _state.jsonl_path:
+                _state.close_jsonl()
+            _state.jsonl_path = jsonl
+        if collect is not None:
+            _state.collect = collect
+        if jax_annotations is not None:
+            _state.jax_annotations = jax_annotations
+        if max_spans is not None and max_spans != _state.max_spans:
+            _state.max_spans = max_spans
+            _state.spans = deque(_state.spans, maxlen=max_spans)
+    return prev
+
+
+def enabled() -> bool:
+    """True when any span sink (collector/JSONL) is active."""
+    _state.ensure_init()
+    return _state.active()
+
+
+def span(name: str, **attrs) -> Span:
+    """Context-manager span: ``with span("materialize.compile", n=3): ...``.
+
+    Always times; records to the active sinks on exit.  With
+    ``TDX_TELEMETRY_JAX=1`` the region is annotated into XLA profiler
+    traces (``step=`` attribute → ``StepTraceAnnotation``).
+    """
+    _state.ensure_init()
+    return Span(name, attrs or None)
+
+
+def start_span(name: str, **attrs) -> Span:
+    """Manual-boundary span: ``sp = start_span(...); ...; sp.end()``."""
+    _state.ensure_init()
+    return Span(name, attrs or None).start()
+
+
+def counter(name: str) -> Counter:
+    """Get-or-create the named counter (bind once at module level on hot
+    paths — the lookup takes the registry lock)."""
+    c = _state.counters.get(name)
+    if c is None:
+        with _REG_LOCK:
+            c = _state.counters.setdefault(name, Counter(name))
+    return c
+
+
+def gauge(name: str) -> Gauge:
+    """Get-or-create the named gauge."""
+    g = _state.gauges.get(name)
+    if g is None:
+        with _REG_LOCK:
+            g = _state.gauges.setdefault(name, Gauge(name))
+    return g
+
+
+def counters() -> Dict[str, int]:
+    """Current counter values, name → count."""
+    return {name: c.value for name, c in sorted(_state.counters.items())}
+
+
+def gauges() -> Dict[str, Any]:
+    """Current gauge values (unset gauges omitted)."""
+    return {
+        name: g.value
+        for name, g in sorted(_state.gauges.items())
+        if g.value is not None
+    }
+
+
+def snapshot() -> Dict[str, Any]:
+    """The in-memory collector as a plain dict:
+    ``{"counters": {...}, "gauges": {...}, "spans": [...]}``."""
+    _state.ensure_init()
+    return {
+        "counters": counters(),
+        "gauges": gauges(),
+        "spans": list(_state.spans),
+    }
+
+
+def drain() -> List[Dict[str, Any]]:
+    """Pop and return all collected span records (oldest first)."""
+    _state.ensure_init()
+    out = []
+    try:
+        while True:
+            out.append(_state.spans.popleft())
+    except IndexError:
+        pass
+    return out
+
+
+def emit_counters() -> None:
+    """Write one counters+gauges snapshot line to the JSONL sink (no-op
+    without one).  Called at natural flush points — the end of each
+    ``materialize_module_jax`` and at interpreter exit."""
+    _state.ensure_init()
+    if _state.jsonl_path is None:
+        return
+    _state.write_jsonl(
+        {
+            "type": "counters",
+            "ts": time.time(),
+            "values": counters(),
+            "gauges": gauges(),
+        }
+    )
+
+
+def reset() -> None:
+    """Zero all counters/gauges and clear collected spans (tests).
+
+    Values are zeroed IN PLACE — instrumented modules bind their Counter
+    objects once at import, so dropping registry entries would leave them
+    counting into objects :func:`counters` can no longer see."""
+    with _REG_LOCK:
+        for c in _state.counters.values():
+            with c._lock:
+                c._value = 0
+        for g in _state.gauges.values():
+            g._value = None
+    _state.spans.clear()
+
+
+def _flush_at_exit() -> None:  # pragma: no cover — interpreter teardown
+    try:
+        if _state.jsonl_path is not None and _state.counters:
+            emit_counters()
+        _state.close_jsonl()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+import atexit  # noqa: E402
+
+atexit.register(_flush_at_exit)

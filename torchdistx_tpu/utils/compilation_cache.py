@@ -21,6 +21,16 @@ from __future__ import annotations
 import os
 import threading
 
+from .. import telemetry as _telemetry
+
+# 1 once the persistent cache is configured, 0 when skipped (CPU backend,
+# TDX_NO_COMPILATION_CACHE, setup failure); unset until first
+# materialization.  A user-configured jax cache dir also reads 1 — the
+# cache is on, just not ours to manage.  The exec-tier hit/miss counters
+# live in materialize (materialize.exec_cache_*): JAX does not expose
+# per-compile persistent-cache hit events to instrument here.
+_T_ENABLED = _telemetry.gauge("compilation_cache.enabled")
+
 _lock = threading.Lock()
 _done = False
 # cache_everything refcount state (guarded by _lock).
@@ -36,12 +46,14 @@ def ensure_compilation_cache() -> None:
         if _done:
             return
         _done = True
+        _T_ENABLED.set(0)
         if os.environ.get("TDX_NO_COMPILATION_CACHE"):
             return
         try:
             import jax
 
             if jax.config.jax_compilation_cache_dir:
+                _T_ENABLED.set(1)
                 return  # user configured their own — leave it alone
             if jax.default_backend() == "cpu":
                 # CPU executables are AOT-compiled against the build host's
@@ -54,6 +66,7 @@ def ensure_compilation_cache() -> None:
             ) or os.path.expanduser("~/.cache/torchdistx_tpu/xla_cache")
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
+            _T_ENABLED.set(1)
         except Exception:
             # Cache is a pure optimization — never fail materialization
             # over it (read-only HOME, old jax flag names, ...).
