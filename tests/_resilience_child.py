@@ -1,0 +1,110 @@
+"""Worker for the crash/preemption-resume e2e (test_crash_resume.py).
+
+Launched as ``python tests/_resilience_child.py <ckpt_dir> <n_steps>
+<steps_log>`` with ``TDX_FAULT`` optionally set in the environment.  Runs
+``fit()`` on the deterministic rig in :func:`run_training`; appends one
+line per EXECUTED optimizer step to ``steps_log`` (flushed immediately,
+so a hard ``os._exit`` crash cannot hide steps), and prints one
+``RESULT {...}`` JSON line on orderly exits.
+
+``run_training`` is also imported by the parent test for the
+uninterrupted reference run — the "identical computation" contract lives
+in exactly one place (the same pattern as tests/_mp_worker.py).
+"""
+
+import json
+import os
+import sys
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+
+def run_training(ckpt_dir, n_steps, on_step=None):
+    """Deterministic tiny run: llama_test on a dp=8 virtual mesh, SGD,
+    fixed data stream.  Returns ``(state, metrics)`` from fit()."""
+    import jax
+    import optax
+
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.parallel import train_step as ts
+    from torchdistx_tpu.parallel.fit import fit
+    from torchdistx_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    cfg = llama.llama_test()
+    mesh = make_mesh(MeshSpec(dp=8))
+    init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.sgd(0.1))
+    bs = ts.batch_sharding(mesh)
+
+    def batches():
+        key = jax.random.PRNGKey(42)
+        while True:
+            key, sub = jax.random.split(key)
+            t = jax.device_put(
+                jax.random.randint(sub, (8, 16), 0, cfg.vocab_size), bs
+            )
+            yield {"tokens": t, "targets": t}
+
+    return fit(
+        init_fn,
+        step_fn,
+        batches(),
+        key=jax.random.PRNGKey(0),
+        n_steps=n_steps,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_every=2,
+        # Synchronous saves: a `crash` fault must not race an in-flight
+        # background write — the replay window stays exactly
+        # checkpoint_every wide even under a hard kill.
+        checkpoint_sync=True,
+        on_metrics=on_step,
+    )
+
+
+def digest(state) -> float:
+    import jax
+    import numpy as np
+
+    return float(
+        sum(np.float64(np.asarray(l).astype("float64").sum())
+            for l in jax.tree.leaves(state.params))
+    )
+
+
+def main() -> None:
+    ckpt_dir, n_steps, steps_log = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    log = open(steps_log, "a", buffering=1)
+
+    def on_step(step, metrics):
+        # One line per executed step, flushed before the next dispatch:
+        # the parent asserts no step ever runs twice across crash+resume.
+        log.write(f"{step}\n")
+        log.flush()
+        os.fsync(log.fileno())
+
+    from torchdistx_tpu import telemetry
+
+    state, _ = run_training(ckpt_dir, n_steps, on_step=on_step)
+    # fit() clears the preemption flag once it has acted on it, so the
+    # counter (not the flag) is the post-hoc evidence of a preemption.
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "final_step": int(state.step),
+                "digest": digest(state),
+                "preempted": telemetry.counters().get(
+                    "train.preemptions", 0
+                ) > 0,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -88,7 +88,8 @@ def test_capacity_drops_tokens(cfg):
 def test_expert_parallel_train_step(cfg):
     mesh = make_mesh(MeshSpec(fsdp=2, ep=4))
     init_fn, step_fn = ts.make_train_step(
-        cfg, mesh, optax.adamw(1e-2), model=moe, attn_impl="jnp"
+        cfg, mesh, optax.adamw(1e-2), model=moe, attn_impl="jnp",
+        nonfinite_guard=False,
     )
     state = init_fn(jax.random.PRNGKey(0))
     assert state.params["layers"]["e_gate"].sharding.spec[1] == "ep"
@@ -152,7 +153,7 @@ def test_pipeline_expert_parallel_train_step(cfg):
     mesh = make_mesh(MeshSpec(pp=2, ep=4))
     init_fn, step_fn = ts.make_train_step(
         cfg2, mesh, optax.sgd(0.1), model=moe, pp_axis="pp",
-        n_microbatches=2, attn_impl="jnp",
+        n_microbatches=2, attn_impl="jnp", nonfinite_guard=False,
     )
     state = init_fn(jax.random.PRNGKey(0))
     assert state.params["layers"]["e_gate"].sharding.spec[:2] == ("pp", "ep")

@@ -115,7 +115,7 @@ def test_pipeline_train_step():
     mesh = make_mesh(axis_names=("tp", "pp"), shape=(2, 4))
     init_fn, step_fn = ts.make_train_step(
         cfg, mesh, optax.sgd(0.1), pp_axis="pp", n_microbatches=2,
-        attn_impl="jnp",
+        attn_impl="jnp", nonfinite_guard=False,
     )
     state = init_fn(jax.random.PRNGKey(0))
     # layer dim sharded over pp
@@ -228,7 +228,7 @@ def test_1f1b_train_step_matches_gpipe():
     def run(schedule):
         init_fn, step_fn = ts.make_train_step(
             cfg, mesh, optax.sgd(0.1), pp_axis="pp", n_microbatches=8,
-            pp_schedule=schedule, attn_impl="jnp",
+            pp_schedule=schedule, attn_impl="jnp", nonfinite_guard=False,
         )
         state = init_fn(jax.random.PRNGKey(0))
         losses = []
@@ -268,7 +268,7 @@ def test_1f1b_wallclock_not_worse_than_gpipe():
     def timed(schedule):
         init_fn, step_fn = ts.make_train_step(
             cfg, mesh, optax.sgd(0.1), pp_axis="pp", n_microbatches=8,
-            pp_schedule=schedule, attn_impl="jnp",
+            pp_schedule=schedule, attn_impl="jnp", nonfinite_guard=False,
         )
         state = init_fn(jax.random.PRNGKey(0))
         state, m = step_fn(state, batch)  # compile

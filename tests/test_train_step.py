@@ -33,7 +33,9 @@ def _batch(cfg, sharding, shape=(8, 32), seed=1):
 class TestTrainStep:
     def test_3d_mesh_loss_decreases(self, cfg):
         mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
-        init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.adamw(1e-2))
+        init_fn, step_fn = ts.make_train_step(
+            cfg, mesh, optax.adamw(1e-2), nonfinite_guard=False
+        )
         state = init_fn(jax.random.PRNGKey(0))
         batch = _batch(cfg, ts.batch_sharding(mesh))
         losses = []
@@ -48,7 +50,9 @@ class TestTrainStep:
         results = []
         for spec in (MeshSpec(dp=8), MeshSpec(fsdp=4, tp=2)):
             mesh = make_mesh(spec)
-            init_fn, step_fn = ts.make_train_step(cfg, mesh, optax.sgd(0.1))
+            init_fn, step_fn = ts.make_train_step(
+                cfg, mesh, optax.sgd(0.1), nonfinite_guard=False
+            )
             state = init_fn(jax.random.PRNGKey(0))
             batch = _batch(cfg, ts.batch_sharding(mesh))
             state, m = step_fn(state, batch)
@@ -60,7 +64,8 @@ class TestTrainStep:
         tokens_shape = (8, 64)
         mesh_sp = make_mesh(MeshSpec(fsdp=2, sp=4))
         init_fn, step_fn = ts.make_train_step(
-            cfg, mesh_sp, optax.sgd(0.1), seq_axis="sp", attn_impl=ring_impl
+            cfg, mesh_sp, optax.sgd(0.1), seq_axis="sp", attn_impl=ring_impl,
+            nonfinite_guard=False,
         )
         state = init_fn(jax.random.PRNGKey(0))
         batch = _batch(cfg, ts.batch_sharding(mesh_sp), tokens_shape)
@@ -68,7 +73,7 @@ class TestTrainStep:
 
         mesh_1 = make_mesh(MeshSpec(dp=8))
         init_fn, step_fn = ts.make_train_step(
-            cfg, mesh_1, optax.sgd(0.1), attn_impl="jnp"
+            cfg, mesh_1, optax.sgd(0.1), attn_impl="jnp", nonfinite_guard=False
         )
         state = init_fn(jax.random.PRNGKey(0))
         batch = _batch(cfg, ts.batch_sharding(mesh_1), tokens_shape)
@@ -188,14 +193,15 @@ def test_zigzag_layout_matches_contiguous(cfg):
     mesh = make_mesh(MeshSpec(fsdp=2, sp=4))
     init_fn, step_fn = ts.make_train_step(
         cfg, mesh, optax.sgd(0.1), seq_axis="sp", attn_impl="ring_zigzag",
-        seq_layout="zigzag",
+        seq_layout="zigzag", nonfinite_guard=False,
     )
     state = init_fn(jax.random.PRNGKey(0))
     batch = _batch(cfg, ts.batch_sharding(mesh), tokens_shape)
     state, m_z = step_fn(state, batch)
 
     init_fn, step_fn = ts.make_train_step(
-        cfg, mesh, optax.sgd(0.1), seq_axis="sp", attn_impl="ring"
+        cfg, mesh, optax.sgd(0.1), seq_axis="sp", attn_impl="ring",
+        nonfinite_guard=False,
     )
     state = init_fn(jax.random.PRNGKey(0))
     state, m_c = step_fn(state, batch)

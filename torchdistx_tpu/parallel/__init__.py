@@ -1,6 +1,8 @@
 from .mesh import make_mesh, MeshSpec  # noqa: F401
 from .distributed import (  # noqa: F401
     ProcessInfo,
+    any_flag,
+    any_flags,
     initialize,
     make_hybrid_mesh,
 )

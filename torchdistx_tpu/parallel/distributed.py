@@ -30,7 +30,13 @@ from typing import Optional, Sequence
 
 from .mesh import MeshSpec, make_mesh
 
-__all__ = ["ProcessInfo", "initialize", "make_hybrid_mesh"]
+__all__ = [
+    "ProcessInfo",
+    "any_flag",
+    "any_flags",
+    "initialize",
+    "make_hybrid_mesh",
+]
 
 _initialized = False
 
@@ -102,6 +108,44 @@ def initialize(
                 raise
     _initialized = True
     return world_info()
+
+
+def any_flag(local: bool) -> bool:
+    """Agree on a host-local boolean across all hosts: True anywhere →
+    True everywhere.  Single-flag convenience over :func:`any_flags`."""
+    return any_flags((local,))[0]
+
+
+def any_flags(local: "Sequence[bool]") -> tuple:
+    """Agree on a vector of host-local booleans across all hosts, in ONE
+    collective: position i of the result is True iff any host passed
+    True at position i.
+
+    The preemption/exit protocol's collective (see
+    :mod:`torchdistx_tpu.resilience.preemption`): the scheduler may
+    SIGTERM hosts at different instants, and per-host data streams may
+    exhaust at different steps, but a resumable checkpoint needs every
+    host to stop at the SAME step — so ``fit()`` folds its exit flags
+    (preemption requested, data exhausted) through this small
+    all-reduce (an element-wise max over processes) at each step
+    boundary before acting on either.
+
+    Degrades to the local flags in a single-process runtime (the common
+    dev/test case — no collective, no cost).  Must be called by every
+    process at the same point in the program, like any collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return tuple(bool(x) for x in local)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(list(local), dtype=np.int32)
+    )
+    agreed = np.asarray(gathered).reshape(-1, len(list(local))).max(axis=0)
+    return tuple(bool(x) for x in agreed)
 
 
 def _degenerate_cpu_slices(devices) -> bool:

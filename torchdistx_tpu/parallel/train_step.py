@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import llama
+from ..resilience import guard as _guard
 from .sharding import fit_shardings
 from .slowmo import SlowMomentumOptimizer, SlowMoState
 
@@ -115,6 +116,7 @@ def make_train_step(
     attn_impl: str = "auto",
     seq_layout: str = "contiguous",
     loss_fn: Optional[Callable] = None,
+    nonfinite_guard: bool = True,
 ) -> Tuple[Callable, Callable]:
     """Build ``(init_fn, step_fn)`` for standard optax training.
 
@@ -131,6 +133,18 @@ def make_train_step(
     ``step_fn(state, batch) -> (state, metrics)`` — one jitted SPMD training
     step; ``batch`` is ``{"tokens": (B,S), "targets": (B,S)}`` sharded with
     :func:`batch_sharding`.  State buffers are donated.
+
+    ``nonfinite_guard`` (default on) adds a jit-side all-reduced
+    finiteness check over loss and gradients: a poisoned step returns
+    the PRIOR state bit-identical (params, optimizer moments, and step
+    counter all unchanged — one NaN gradient must not corrupt optimizer
+    state forever) and reports ``metrics["nonfinite"]=True`` so the
+    training loop can count skips and escalate (see
+    :mod:`torchdistx_tpu.resilience.guard`).  A clean step's update is
+    unaffected — the select picks the freshly computed state.  The
+    reserved batch key ``_tdx_nan`` (injected by ``fit()`` under a
+    ``TDX_FAULT=step.exec:N:nan`` spec) deterministically poisons the
+    loss for fault-injection tests.
 
     ``pp_schedule``: ``"gpipe"`` (autodiff through the pipeline scan) or
     ``"1f1b"`` (hand-written interleaved backward with O(P) live
@@ -229,12 +243,32 @@ def make_train_step(
             loss, grads = jax.value_and_grad(_loss)(
                 state.params, batch["tokens"], batch["targets"]
             )
+        if "_tdx_nan" in batch:
+            # Deterministic fault injection (resilience.faults, kind
+            # "nan"): poison the loss so the guard's real detection path
+            # trips — the key only exists on injected calls, so clean
+            # steps compile without this select.
+            loss = jnp.where(
+                jnp.asarray(batch["_tdx_nan"]),
+                jnp.asarray(jnp.nan, dtype=loss.dtype),
+                loss,
+            )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         import optax
 
         params = optax.apply_updates(state.params, updates)
-        metrics = {"loss": loss, "step": state.step + 1}
-        return TrainState(params, opt_state, state.step + 1), metrics
+        new_state = TrainState(params, opt_state, state.step + 1)
+        if nonfinite_guard:
+            ok = _guard.tree_allfinite(loss, grads)
+            new_state = _guard.select_tree(ok, new_state, state)
+            metrics = {
+                "loss": loss,
+                "step": new_state.step,
+                "nonfinite": ~ok,
+            }
+        else:
+            metrics = {"loss": loss, "step": new_state.step}
+        return new_state, metrics
 
     return init_fn, step_fn
 

@@ -1,0 +1,59 @@
+"""Resilience: preemption-safe training, retrying IO, non-finite guards,
+and deterministic fault injection.
+
+Long runs on preemptible TPU fleets fail in exactly four boring ways —
+the scheduler reclaims the VM (SIGTERM), checkpoint/data IO hiccups
+(transient orbax/GCS errors), a step produces non-finite loss/grads, and
+"it crashed and must resume where it left off".  This package makes each
+of those a first-class, *observable* path:
+
+* :mod:`~torchdistx_tpu.resilience.retry` — :class:`RetryPolicy`:
+  exponential backoff + jitter with attempt/deadline caps and
+  retryable-exception classification, applied to checkpoint IO and the
+  ``fit()`` data iterator (``ckpt.retries`` / ``data.retries`` counters).
+* :mod:`~torchdistx_tpu.resilience.preemption` — SIGTERM/SIGINT handlers
+  that set a flag checked at every step boundary; on preemption ``fit()``
+  checkpoints the current step, flushes telemetry, and returns resumably
+  (multihost: the flag is agreed via
+  :func:`torchdistx_tpu.parallel.distributed.any_flag`).
+* :mod:`~torchdistx_tpu.resilience.guard` — jit-side finiteness check
+  over loss+grads with skip-step semantics (prior state returned
+  unchanged, ``train.skipped_steps`` bumped) and host-side escalation
+  (:class:`NonFiniteError` after K consecutive skips).
+* :mod:`~torchdistx_tpu.resilience.faults` — deterministic fault
+  injection (``TDX_FAULT="site:step:kind"``) so tests and CI prove the
+  crash/retry/skip paths without flaky process games.
+
+Like :mod:`~torchdistx_tpu.telemetry`, the package is dependency-free at
+module level (stdlib only; jax imports live inside the functions that
+need them), so it is importable in the torch-only environment.
+
+See ``docs/resilience.md`` for semantics and knobs.
+"""
+
+from .faults import (  # noqa: F401
+    CRASH_EXIT_CODE,
+    FaultSpec,
+    InjectedFault,
+    parse_faults,
+)
+from .guard import NonFiniteError, SkipTracker, select_tree, tree_allfinite  # noqa: F401
+from .retry import RetriesExhausted, RetryPolicy  # noqa: F401
+from . import faults, guard, preemption, retry  # noqa: F401
+
+__all__ = [
+    "CRASH_EXIT_CODE",
+    "FaultSpec",
+    "InjectedFault",
+    "NonFiniteError",
+    "RetriesExhausted",
+    "RetryPolicy",
+    "SkipTracker",
+    "faults",
+    "guard",
+    "parse_faults",
+    "preemption",
+    "retry",
+    "select_tree",
+    "tree_allfinite",
+]

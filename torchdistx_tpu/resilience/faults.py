@@ -1,0 +1,187 @@
+"""Deterministic fault injection: ``TDX_FAULT="site:step:kind[,...]"``.
+
+Proving crash/retry/skip paths with real process games (kill -9 at "about
+the right time", flaky network mocks) makes resilience tests the least
+reliable tests in a suite.  Instead, named *sites* in the training stack
+ask this registry "do I fail now?" — the answer is a pure function of
+the ``TDX_FAULT`` spec and the step number, so every CI run exercises
+exactly the same failure at exactly the same step.
+
+Grammar (comma-separated specs)::
+
+    TDX_FAULT="site:step:kind[,site:step:kind...]"
+
+Sites (where the stack asks):
+
+* ``ckpt.save``  — inside ``Checkpointer.save``, before orbax runs (so a
+  retry re-enters the site and succeeds once the spec is consumed).
+* ``data.next``  — in ``fit()`` before pulling the next batch.
+* ``step.exec``  — in ``fit()`` before executing the step.
+
+Kinds (what happens):
+
+* ``io``      — raise :class:`InjectedFault` (an ``OSError``: retryable
+  under the default :class:`~torchdistx_tpu.resilience.retry.RetryPolicy`).
+* ``fatal``   — raise :class:`FatalInjectedFault` (a ``RuntimeError``:
+  NOT retryable; proves fatal errors propagate).
+* ``crash``   — ``os._exit(CRASH_EXIT_CODE)``: a hard kill, no ``finally``
+  blocks, no atexit — the SIGKILL/power-loss simulation.
+* ``sigterm`` — ``os.kill(os.getpid(), SIGTERM)``: a real signal through
+  the real handler — the preemption simulation.
+* ``nan``     — only meaningful at ``step.exec``: ``fit()`` poisons the
+  step's loss (via the reserved ``_tdx_nan`` batch key understood by
+  ``make_train_step``) so the jit-side non-finite guard trips.
+
+``step`` is the 1-based global step number.  Each spec fires ONCE (the
+first time its site+step matches), so a retried site succeeds on the
+next attempt; every firing bumps the ``faults.fired`` counter.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .. import telemetry as _telemetry
+
+__all__ = [
+    "CRASH_EXIT_CODE",
+    "FatalInjectedFault",
+    "FaultSpec",
+    "InjectedFault",
+    "active",
+    "fire",
+    "parse_faults",
+    "reset",
+]
+
+ENV_VAR = "TDX_FAULT"
+CRASH_EXIT_CODE = 13
+SITES = frozenset({"ckpt.save", "data.next", "step.exec"})
+KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan"})
+
+_T_FIRED = _telemetry.counter("faults.fired")
+
+
+class InjectedFault(OSError):
+    """A transient injected failure (retryable by default policies)."""
+
+
+class FatalInjectedFault(RuntimeError):
+    """An injected failure no policy should retry."""
+
+
+@dataclass
+class FaultSpec:
+    site: str
+    step: int
+    kind: str
+    fired: bool = field(default=False, compare=False)
+
+
+def parse_faults(text: str) -> List[FaultSpec]:
+    """Parse a ``TDX_FAULT`` value; raises ``ValueError`` on bad grammar
+    (a mistyped injection silently doing nothing would "pass" CI)."""
+    specs: List[FaultSpec] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pieces = part.split(":")
+        if len(pieces) != 3:
+            raise ValueError(
+                f"TDX_FAULT spec {part!r}: expected 'site:step:kind'"
+            )
+        site, step_s, kind = (p.strip() for p in pieces)
+        if site not in SITES:
+            raise ValueError(
+                f"TDX_FAULT spec {part!r}: unknown site {site!r} "
+                f"(sites: {sorted(SITES)})"
+            )
+        if kind not in KINDS:
+            raise ValueError(
+                f"TDX_FAULT spec {part!r}: unknown kind {kind!r} "
+                f"(kinds: {sorted(KINDS)})"
+            )
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"TDX_FAULT spec {part!r}: step {step_s!r} is not an int"
+            ) from None
+        if step < 1:
+            raise ValueError(
+                f"TDX_FAULT spec {part!r}: step must be >= 1 (1-based)"
+            )
+        specs.append(FaultSpec(site, step, kind))
+    return specs
+
+
+class _Registry:
+    """Process singleton, lazily seeded from ``TDX_FAULT``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: Optional[List[FaultSpec]] = None
+
+    def _ensure(self) -> List[FaultSpec]:
+        if self._specs is None:
+            with self._lock:
+                if self._specs is None:
+                    text = os.environ.get(ENV_VAR, "")
+                    self._specs = parse_faults(text) if text else []
+        return self._specs
+
+    def reset(self, text: Optional[str] = None) -> None:
+        """Reload from ``text`` (tests) or from the environment."""
+        with self._lock:
+            self._specs = parse_faults(text) if text is not None else None
+
+    def active(self) -> bool:
+        return bool(self._ensure())
+
+    def check(self, site: str, step: int) -> Optional[str]:
+        """Consume and return the kind of the first unfired matching
+        spec, or None.  Does not act on the kind."""
+        specs = self._ensure()
+        if not specs:  # fast path: registry empty in production
+            return None
+        with self._lock:
+            for spec in specs:
+                if not spec.fired and spec.site == site and spec.step == step:
+                    spec.fired = True
+                    _T_FIRED.add()
+                    return spec.kind
+        return None
+
+
+_registry = _Registry()
+
+reset = _registry.reset
+active = _registry.active
+
+
+def fire(site: str, step: int) -> Optional[str]:
+    """Ask the registry whether to fail at ``site`` for ``step`` — and
+    act: raise for ``io``/``fatal``, hard-exit for ``crash``, signal for
+    ``sigterm``.  Kinds that need caller cooperation (``nan``) are
+    returned; None means "no fault here".
+    """
+    kind = _registry.check(site, step)
+    if kind is None:
+        return None
+    if kind == "io":
+        raise InjectedFault(f"injected io fault at {site}:{step}")
+    if kind == "fatal":
+        raise FatalInjectedFault(f"injected fatal fault at {site}:{step}")
+    if kind == "crash":
+        os._exit(CRASH_EXIT_CODE)
+    if kind == "sigterm":
+        # A REAL signal through the real handler chain: the preemption
+        # path under test is the production path, not a mock of it.
+        os.kill(os.getpid(), signal.SIGTERM)
+        return None
+    return kind

@@ -1,0 +1,107 @@
+"""Non-finite step guard: skip poisoned steps, escalate when they persist.
+
+One NaN/Inf gradient silently corrupts optimizer moments forever — every
+later step inherits the poison.  The guard splits into a jit-side check
+and a host-side policy:
+
+* **jit-side** (:func:`tree_allfinite` + :func:`select_tree`, wired into
+  ``make_train_step``): an all-reduced finiteness check over loss and
+  every gradient leaf — ``isfinite(x).all()`` over sharded arrays, so
+  the SPMD partitioner inserts the cross-device reduction — selecting
+  the PRIOR state when the step is poisoned.  Skipped steps leave
+  params, optimizer moments, and the step counter bit-identical to
+  before the step; the metrics dict carries ``nonfinite`` so the host
+  can see it.
+* **host-side** (:class:`SkipTracker`, used by ``fit()``): bumps the
+  ``train.skipped_steps`` counter per skip and raises
+  :class:`NonFiniteError` after ``max_consecutive`` skips in a row — a
+  persistently diverging run must fail loudly (lower the LR, inspect
+  the data), not spin forever skipping.
+
+The module is import-light (jax only inside functions) to keep the
+resilience package importable in the torch-only environment.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .. import telemetry as _telemetry
+
+__all__ = ["NonFiniteError", "SkipTracker", "select_tree", "tree_allfinite"]
+
+_T_SKIPPED = _telemetry.counter("train.skipped_steps")
+
+
+class NonFiniteError(RuntimeError):
+    """Raised after ``max_consecutive`` non-finite steps in a row."""
+
+    def __init__(self, step: int, consecutive: int):
+        self.step = step
+        self.consecutive = consecutive
+        super().__init__(
+            f"{consecutive} consecutive non-finite training step(s), "
+            f"last at step {step}: loss/grads contain NaN or Inf and "
+            "skipping is not recovering — stopping so the run can be "
+            "restarted from the last checkpoint with different "
+            "hyperparameters."
+        )
+
+
+def tree_allfinite(*trees: Any):
+    """Scalar bool: every inexact-dtype leaf of every tree is finite.
+
+    Traced under jit this lowers to per-leaf ``isfinite().all()``
+    reductions; on sharded leaves XLA all-reduces across devices, so
+    every shard agrees on the verdict (the "all-reduced finiteness
+    check").  Integer/bool leaves are skipped — they cannot be
+    non-finite.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.asarray(True)
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            arr = jnp.asarray(leaf)
+            if jnp.issubdtype(arr.dtype, jnp.inexact):
+                ok = ok & jnp.isfinite(arr).all()
+    return ok
+
+
+def select_tree(ok, new: Any, old: Any) -> Any:
+    """``new`` where ``ok`` else ``old``, leafwise (skip-step select).
+
+    Both trees must share structure (they are the post- and pre-step
+    TrainState).  ``jnp.where`` with a scalar predicate compiles to a
+    select per leaf — no host sync, donation-compatible.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+class SkipTracker:
+    """Host-side escalation policy over the per-step ``nonfinite`` flag.
+
+    ``observe(skipped, step)`` bumps ``train.skipped_steps`` and raises
+    :class:`NonFiniteError` once ``max_consecutive`` skips arrive with
+    no finite step in between.  ``max_consecutive <= 0`` disables
+    escalation (skips are still counted).
+    """
+
+    def __init__(self, max_consecutive: int = 8):
+        self.max_consecutive = max_consecutive
+        self.consecutive = 0
+        self.total = 0
+
+    def observe(self, skipped: bool, step: int) -> None:
+        if not skipped:
+            self.consecutive = 0
+            return
+        self.total += 1
+        self.consecutive += 1
+        _T_SKIPPED.add()
+        if 0 < self.max_consecutive <= self.consecutive:
+            raise NonFiniteError(step, self.consecutive)

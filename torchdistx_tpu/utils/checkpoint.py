@@ -15,7 +15,15 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
+from .. import telemetry as _telemetry
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy
+
 __all__ = ["save_state", "restore_state", "latest_step", "Checkpointer"]
+
+# Granted retries of checkpoint IO (save dispatch + restore), visible in
+# traces so flaky storage degrades loudly instead of silently.
+_T_CKPT_RETRIES = _telemetry.counter("ckpt.retries")
 
 
 def _ocp():
@@ -67,16 +75,35 @@ class Checkpointer:
 
     ``Checkpointer(dir).save(step, state)`` keeps the ``max_to_keep`` most
     recent steps; ``restore_latest(target=...)`` resumes.
+
+    ``retry`` (a :class:`~torchdistx_tpu.resilience.retry.RetryPolicy`)
+    makes save dispatch and restore survive transient IO errors —
+    attempts beyond the first bump the ``ckpt.retries`` counter.  Saves
+    are safe to re-enter: orbax writes into a temporary step directory
+    and commits atomically, so a failed attempt leaves no committed
+    step behind.
     """
 
-    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3):
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        max_to_keep: int = 3,
+        retry: Optional[RetryPolicy] = None,
+    ):
         ocp = _ocp()
+        self._retry = retry
         self._mgr = ocp.CheckpointManager(
             os.fspath(directory),
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
         )
+
+    def _call(self, fn, *, site: str):
+        if self._retry is None:
+            return fn()
+        return self._retry.call(fn, counter=_T_CKPT_RETRIES, site=site)
 
     def save(self, step: int, state: Any, *, wait: bool = True) -> None:
         """Write a checkpoint for ``step``.
@@ -91,7 +118,12 @@ class Checkpointer:
         wait.
         """
         ocp = _ocp()
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+        def _save():
+            _faults.fire("ckpt.save", step)
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+        self._call(_save, site=f"ckpt.save[{step}]")
         if wait:
             self._mgr.wait_until_finished()
 
@@ -122,10 +154,26 @@ class Checkpointer:
             args = ocp.args.StandardRestore(abstract)
         else:
             args = None
-        return step, self._mgr.restore(step, args=args)
+        restored = self._call(
+            lambda: self._mgr.restore(step, args=args),
+            site=f"ckpt.restore[{step}]",
+        )
+        return step, restored
 
 
 def latest_step(directory: str | os.PathLike) -> Optional[int]:
+    """Latest committed step under ``directory``, or None.
+
+    A pure read: querying a run that never checkpointed must not create
+    its directory (CheckpointManager's default options would, as a side
+    effect), so a missing directory short-circuits and the manager is
+    built with ``create=False``.
+    """
+    if not os.path.isdir(os.fspath(directory)):
+        return None
     ocp = _ocp()
-    mgr = ocp.CheckpointManager(os.fspath(directory))
+    mgr = ocp.CheckpointManager(
+        os.fspath(directory),
+        options=ocp.CheckpointManagerOptions(create=False),
+    )
     return mgr.latest_step()

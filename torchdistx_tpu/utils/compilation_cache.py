@@ -30,6 +30,11 @@ from .. import telemetry as _telemetry
 # live in materialize (materialize.exec_cache_*): JAX does not expose
 # per-compile persistent-cache hit events to instrument here.
 _T_ENABLED = _telemetry.gauge("compilation_cache.enabled")
+# Swallowed cache-management failures (setup, threshold save/restore).
+# The cache is a pure optimization — errors must never fail the caller —
+# but silent degradation (every compile suddenly cold) must still be
+# visible in traces, so every swallowed exception counts here.
+_T_ERRORS = _telemetry.counter("compile_cache.errors")
 
 _lock = threading.Lock()
 _done = False
@@ -70,7 +75,7 @@ def ensure_compilation_cache() -> None:
         except Exception:
             # Cache is a pure optimization — never fail materialization
             # over it (read-only HOME, old jax flag names, ...).
-            pass
+            _T_ERRORS.add()
 
 
 class cache_everything:
@@ -114,13 +119,14 @@ class cache_everything:
                     # Partial failure (e.g. a flag renamed in a newer jax):
                     # roll back what WAS applied rather than leaving the
                     # aggressive thresholds process-global.
+                    _T_ERRORS.add()
                     try:
                         import jax
 
                         for name, value in _ce_saved:
                             jax.config.update(name, value)
                     except Exception:
-                        pass
+                        _T_ERRORS.add()
                     _ce_saved = []
         return self
 
@@ -135,6 +141,6 @@ class cache_everything:
                     for name, value in _ce_saved:
                         jax.config.update(name, value)
                 except Exception:
-                    pass
+                    _T_ERRORS.add()
                 _ce_saved = []
         return False
