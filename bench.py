@@ -496,6 +496,102 @@ def bench_generate():
     return out
 
 
+def bench_serving():
+    """Continuous-batching serving throughput of the flagship stack.
+
+    The serving-path decode ratchet: the same 350M llama as
+    ``bench_generate``, but behind the serving engine — 8 decode slots
+    over a paged KV cache, mixed prompt/output lengths, Poisson-ish
+    arrivals from a fixed seed.  Reports SUSTAINED decode tok/s
+    (committed tokens / decode-dispatch time, slots kept full by
+    continuous batching), TTFT p50/p95 (queue wait included), and peak
+    block utilization.  Contrast with ``generate_llama_350m_decode``:
+    there the whole batch finishes together and the cache is allocated
+    at ``prompt+max_new`` per row; here slots recycle the moment a
+    request's budget lands and pages free with them.
+    """
+    import jax
+    import numpy as np
+
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.parallel.mesh import make_mesh, MeshSpec
+    from torchdistx_tpu.serving import Engine
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
+        ffn_dim=4096, max_seq_len=1024, remat=False,
+    )
+    params = llama.init_sharded(
+        jax.random.PRNGKey(0), cfg, make_mesh(MeshSpec(fsdp=1))
+    )
+    num_slots, block_size, max_model_len, chunk = 8, 32, 512, 16
+    # 87.5% of dense capacity: paging has to work (requests queue when
+    # pages run out), without starving the slots.
+    num_blocks = 1 + int(num_slots * (max_model_len // block_size) * 7 / 8)
+
+    def make_engine():
+        return Engine(
+            params, model=llama, cfg=cfg, num_slots=num_slots,
+            block_size=block_size, num_blocks=num_blocks,
+            max_model_len=max_model_len, decode_chunk=chunk,
+            min_prefill_bucket=32,
+        )
+
+    rng = np.random.default_rng(0)
+    n_req = 32
+    plens = rng.integers(32, 192, size=n_req)
+    outs = rng.integers(64, 256, size=n_req)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=int(p)).astype(np.int32)
+        for p in plens
+    ]
+    # Poisson-ish arrivals: inter-arrival gaps in engine ticks.
+    arrival = np.cumsum(rng.poisson(1.0, size=n_req))
+
+    # Warm every compiled program (prefill per bucket + the decode chunk)
+    # on a throwaway engine; the measured engine reuses the jit cache.
+    warm = make_engine()
+    wrng = np.random.default_rng(1)
+    for p in (32, 64, 128, 192):  # covers every prefill bucket used below
+        warm.submit(
+            wrng.integers(0, cfg.vocab_size, size=p).astype(np.int32),
+            max_new_tokens=4, key=0,
+        )
+    warm.drain()
+
+    eng = make_engine()
+    peak_util = 0.0
+    t0 = time.perf_counter()
+    i = 0
+    tick = 0
+    while i < n_req or len(eng.scheduler) or eng.stats()["running"]:
+        while i < n_req and arrival[i] <= tick:
+            eng.submit(
+                prompts[i], max_new_tokens=int(outs[i]), key=i
+            )
+            i += 1
+        eng.step()
+        tick += 1
+        peak_util = max(peak_util, eng.allocator.utilization())
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    total_tokens = int(sum(outs))
+    return {
+        "n_requests": n_req,
+        "num_slots": num_slots,
+        "block_size": block_size,
+        "num_blocks": num_blocks,
+        "decode_chunk": chunk,
+        "total_new_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "e2e_tokens_per_s": round(total_tokens / wall, 1),
+        "sustained_decode_tokens_per_s": st.get("decode_tokens_per_s"),
+        "ttft_p50_s": st.get("ttft_p50_s"),
+        "ttft_p95_s": st.get("ttft_p95_s"),
+        "peak_block_utilization": round(peak_util, 4),
+    }
+
+
 def bench_flash_attention(s=16384, b=1, h=8, d=128):
     """Long-context flash attention fwd+bwd at S=16k on one chip.
 
@@ -602,6 +698,18 @@ def main():
         gen = bench_generate()
     except Exception as e:  # noqa: BLE001
         gen = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        serving = bench_serving()
+        # The serving ratchet reads directly against the solo-generate
+        # row it shares hardware (and a model config) with.
+        if "error" not in gen and gen.get("e2e_tokens_per_s"):
+            sus = serving.get("sustained_decode_tokens_per_s")
+            if sus:
+                serving["vs_generate_e2e"] = round(
+                    sus / gen["e2e_tokens_per_s"], 3
+                )
+    except Exception as e:  # noqa: BLE001
+        serving = {"error": f"{type(e).__name__}: {e}"}
     # Second flash probe, minutes after the first (same compiled program,
     # deterministic work): tunnel windows last minutes, so two temporally
     # separated samples of the same measurement keep one bad window from
@@ -645,6 +753,7 @@ def main():
                     "train_step_llama_350m_pallas": train,
                     "flash_attention_16k": flash16k,
                     "generate_llama_350m_decode": gen,
+                    "serving_llama_350m_continuous": serving,
                     "cold_uncached_s": cold,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),

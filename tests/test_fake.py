@@ -117,3 +117,22 @@ def test_fake_inplace_and_views():
     # In-place op returns the same fake wrapper (fake.cc:507-523).
     v = t.mul_(2)
     assert v is t
+
+
+def test_tpu_spoof_persists_after_mode_exit():
+    """Pins the DELIBERATE exit asymmetry vs the reference's scoped
+    device-guard spoof (fake.cc:574-586): the "tpu" rename persists after
+    every fake mode exits — the name must keep resolving for fakes that
+    outlive their mode (the deferred-init flow) — but no fake hardware
+    becomes reachable: a REAL tpu allocation still fails at dispatch.
+    See docs/fake_tensor.md, "Deliberate exit asymmetry"."""
+    with fake.fake_mode():
+        t = torch.ones(3, device="tpu")
+    # After exit: the device string still parses, and the escaped fake
+    # still reports it.
+    assert torch.device("tpu").type == "tpu"
+    assert t.device.type == "tpu"
+    # But the spoof registered no kernels: a non-fake tpu tensor cannot
+    # actually be created outside a fake mode.
+    with pytest.raises(RuntimeError):
+        torch.ones(3, device="tpu")

@@ -77,6 +77,60 @@ def test_generate_eos_padding(family):
     assert bool((out == out[0, 0]).all())
 
 
+def test_generate_post_eos_semantics(family):
+    """Pins post-EOS token semantics around the all-done early exit:
+    (a) after a row's first eos, that row emits ONLY eos; (b) a row that
+    has not finished keeps generating its normal greedy tokens (the
+    early exit must not fire while anyone is live); (c) tokens equal the
+    eos_id=None run up to each row's first eos (the exit changes cost,
+    never values)."""
+    model, cfg = family
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(9), (2, 6), 0, cfg.vocab_size
+    )
+    free = generate(
+        params, prompt, jax.random.PRNGKey(0),
+        model=model, cfg=cfg, max_new_tokens=10, temperature=0.0,
+    )
+    # Pick an eos that row 0 emits mid-generation and row 1 never does —
+    # row 0's token at step 2 (cfg-dependent but deterministic).
+    eos = int(free[0, 2])
+    if eos in [int(t) for t in free[1]]:
+        pytest.skip("both rows emit the candidate eos; cfg-dependent")
+    out = generate(
+        params, prompt, jax.random.PRNGKey(0),
+        model=model, cfg=cfg, max_new_tokens=10, temperature=0.0,
+        eos_id=eos,
+    )
+    row0 = [int(t) for t in out[0]]
+    first = row0.index(eos)
+    assert first <= 2
+    assert all(t == eos for t in row0[first:]), "post-eos must be all eos"
+    assert row0[:first] == [int(t) for t in free[0, :first]]
+    # Row 1 never hits eos: identical to the unconstrained run throughout.
+    assert [int(t) for t in out[1]] == [int(t) for t in free[1]]
+
+
+def test_generate_all_done_early_exit_value_preserving():
+    """When EVERY row hits eos at the first token, the early-exit path
+    serves all remaining steps — output must still be the eos fill.
+    (llama only: the cond lives in model-agnostic generate.py.)"""
+    model, cfg = llama, llama.llama_test()
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.zeros((2, 4), dtype=jnp.int32)
+    eos = int(jnp.argmax(
+        model.forward(params, prompt, cfg, attn_impl="jnp")[0, -1]
+    ))
+    out = generate(
+        params, prompt, jax.random.PRNGKey(0),
+        model=model, cfg=cfg, max_new_tokens=12, temperature=0.0,
+        eos_id=eos,
+    )
+    assert out.shape == (2, 12)
+    assert bool((out == eos).all())
+
+
 def test_generate_sampling_reproducible(family):
     model, cfg = family
     params = model.init_params(jax.random.PRNGKey(0), cfg)

@@ -95,6 +95,54 @@ def test_sharded_values_match_unsharded():
         )
 
 
+def test_replicate_mesh_args_places_explicitly():
+    """VERDICT item 8b: mesh-job argument leaves are handed to compiled
+    executables as explicitly mesh-replicated arrays — never as raw host
+    numpy relying on Compiled.__call__'s version-dependent tolerance."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from torchdistx_tpu.materialize import _replicate_mesh_args
+
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    args = [
+        (np.arange(6, dtype=np.uint32), [np.ones((2, 3), np.float32)]),
+        (np.float64(2.5), 7),  # non-array leaves pass through untouched
+    ]
+    placed = _replicate_mesh_args(args, mesh)
+    rep = NamedSharding(mesh, PartitionSpec())
+    a0, (a1,) = placed[0]
+    for arr, src in ((a0, args[0][0]), (a1, args[0][1][0])):
+        assert isinstance(arr, jax.Array)
+        assert arr.sharding.is_equivalent_to(rep, arr.ndim)
+        np.testing.assert_array_equal(np.asarray(arr), src)
+    assert placed[1] == args[1]
+
+
+def test_sharded_mesh_jobs_fed_replicated_inputs():
+    """End-to-end: a mesh materialization routes its rest-job args
+    through _replicate_mesh_args (values already pinned by
+    test_sharded_values_match_unsharded; this pins the placement)."""
+    import torchdistx_tpu.materialize as mz
+
+    mesh = make_mesh(MeshSpec(fsdp=8))
+    seen = []
+    orig = mz._replicate_mesh_args
+
+    def spy(all_args, m):
+        out = orig(all_args, m)
+        seen.append(out)
+        return out
+
+    mz._replicate_mesh_args = spy
+    try:
+        m = di.deferred_init(nn.Linear, 64, 32)
+        materialize_module_jax(m, mesh=mesh, plan=fsdp_plan(min_size=1))
+    finally:
+        mz._replicate_mesh_args = orig
+    assert seen, "mesh run never placed its job args explicitly"
+
+
 def test_tp_plan_gpt2_specs():
     plan = tp_plan_gpt2()
     assert tuple(plan("transformer.h.0.attn.c_attn.weight", (768, 2304))) == (None, "tp")

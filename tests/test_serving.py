@@ -1,0 +1,395 @@
+"""Serving engine: paged KV cache + continuous batching ≡ solo generate.
+
+The acceptance bar (ISSUE 3): engine output for every request is
+token-identical to a solo ``generate()`` call with the same key — greedy
+and sampled, under out-of-order admission and mid-stream slot recycling —
+and the block allocator never double-assigns or leaks (exhaustion is
+backpressure, not a crash).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.models import gpt2, llama
+from torchdistx_tpu.models.generate import generate
+from torchdistx_tpu.ops.attention import cached_attention, paged_attention
+from torchdistx_tpu.resilience import faults
+from torchdistx_tpu.serving import (
+    BlockAllocator,
+    Engine,
+    blocks_needed,
+    init_paged_cache,
+    write_prompt,
+)
+
+EOS = 5
+
+
+@pytest.fixture(scope="module", params=["llama", "gpt2"])
+def family(request):
+    if request.param == "llama":
+        cfg = llama.llama_test()
+        model = llama
+    else:
+        cfg = gpt2.gpt2_test()
+        model = gpt2
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    return model, cfg, params
+
+
+def solo(model, cfg, params, prompt, seed, max_new, *, eos=None,
+         temperature=0.0, top_k=None):
+    """Reference: solo generate, truncated at first EOS (inclusive) the
+    way a finished serving request's token stream is."""
+    out = generate(
+        params, jnp.asarray(prompt)[None], jax.random.PRNGKey(seed),
+        model=model, cfg=cfg, max_new_tokens=max_new,
+        temperature=temperature, top_k=top_k, eos_id=eos,
+    )
+    toks = [int(t) for t in np.asarray(out)[0]]
+    if eos is not None and eos in toks:
+        toks = toks[: toks.index(eos) + 1]
+    return toks
+
+
+# Canonical engine geometry shared by most tests below: one decode-chunk
+# compile and one prefill bucket per sampling config for the whole module
+# (generate/forward_cached compile per static max_new_tokens too, so
+# budgets come from a small fixed menu).
+ENGINE_KW = dict(num_slots=2, block_size=8, max_model_len=64, decode_chunk=4)
+
+
+def mixed_requests(rng, cfg, n, budgets=(5, 9, 16)):
+    """Out-of-order lengths: prompts and budgets drawn independently
+    (budgets from a fixed menu — each distinct budget is a distinct solo
+    generate compile)."""
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(3, 16))
+        mnt = int(rng.choice(budgets))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        reqs.append((prompt, mnt, i))
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# Block allocator
+
+
+def test_allocator_basics():
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    assert a.capacity == 7  # page 0 is trash
+    got = a.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert a.num_in_use == 3
+    assert a.alloc(5) is None  # only 4 left: no partial grant
+    assert a.num_in_use == 3  # failed alloc took nothing
+    a.free(got)
+    assert a.num_in_use == 0 and a.num_free == 7
+
+
+def test_allocator_never_double_assigns():
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    grants = [a.alloc(3) for _ in range(5)]
+    flat = [b for g in grants for b in g]
+    assert len(flat) == len(set(flat)) == 15
+    assert a.alloc(1) is None  # exhausted → backpressure signal
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(num_blocks=4, block_size=4)
+    got = a.alloc(2)
+    a.free(got)
+    with pytest.raises(RuntimeError, match="not in use"):
+        a.free(got)
+    with pytest.raises(RuntimeError, match="not in use"):
+        a.free([0])  # the trash page is never owned
+
+
+def test_blocks_needed():
+    assert blocks_needed(1, 8) == 1
+    assert blocks_needed(8, 8) == 1
+    assert blocks_needed(9, 8) == 2
+
+
+# ---------------------------------------------------------------------------
+# Paged attention + prompt scatter
+
+
+def test_paged_attention_matches_cached():
+    """Block-table gather + per-slot mask ≡ contiguous cached_attention."""
+    key = jax.random.PRNGKey(0)
+    b, hq, hkv, d, bs, m = 3, 4, 2, 8, 4, 4
+    smax = m * bs
+    q = jax.random.normal(jax.random.fold_in(key, 0), (b, 1, hq, d))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (b, smax, hkv, d))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (b, smax, hkv, d))
+    positions = jnp.asarray([5, 11, 2])
+
+    # Lay the same contiguous cache out as pages: slot i's block j is page
+    # 1 + i*m + j (page 0 trash, filled with junk to prove masking).
+    k_pages = jnp.concatenate(
+        [
+            jnp.full((1, bs, hkv, d), 7.7, k.dtype),
+            k.reshape(b * m, bs, hkv, d),
+        ]
+    )
+    v_pages = jnp.concatenate(
+        [
+            jnp.full((1, bs, hkv, d), -3.3, v.dtype),
+            v.reshape(b * m, bs, hkv, d),
+        ]
+    )
+    tables = 1 + jnp.arange(b * m).reshape(b, m)
+
+    paged = paged_attention(q, k_pages, v_pages, tables, positions)
+    for i in range(b):
+        ref = cached_attention(
+            q[i : i + 1], k[i : i + 1], v[i : i + 1], positions[i]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(paged[i : i + 1]), np.asarray(ref),
+            err_msg=f"slot {i}",
+        )
+
+
+def test_write_prompt_scatter_and_trash(family):
+    model, cfg, params = family
+    bs, nb = 4, 9
+    paged = init_paged_cache(model, cfg, nb, bs)
+    length, p_pad = 6, 16  # pad tail must land in trash, not real pages
+    scratch = model.init_cache(cfg, 1, p_pad)
+    tokens = jnp.arange(1, p_pad + 1, dtype=jnp.int32)[None] % cfg.vocab_size
+    _, scratch = model.forward_cached(params, tokens, cfg, scratch, 0)
+    table = np.zeros((4,), np.int32)
+    table[:2] = [3, 7]  # blocks_needed(6, 4) == 2
+    paged = write_prompt(paged, scratch, jnp.asarray(table), length,
+                         block_size=bs)
+
+    k_pages = np.asarray(paged["k"])
+    k_ref = np.asarray(scratch["k"])[:, 0]  # (L, P, H, D)
+    np.testing.assert_array_equal(k_pages[:, 3], k_ref[:, 0:4])
+    np.testing.assert_array_equal(k_pages[:, 7, :2], k_ref[:, 4:6])
+    # Positions >= length went to trash page 0; pages the table never
+    # named stayed zero.
+    np.testing.assert_array_equal(k_pages[:, 7, 2:], 0 * k_pages[:, 7, 2:])
+    for untouched in (1, 2, 4, 5, 6, 8):
+        assert not np.any(k_pages[:, untouched])
+
+
+# ---------------------------------------------------------------------------
+# Engine ≡ solo generate
+
+
+def test_engine_greedy_token_identical(family):
+    """2 slots, 6 mixed requests: admission is out-of-order relative to
+    completion, every retire recycles a slot mid-stream — and every
+    request's tokens equal its solo generate() run."""
+    model, cfg, params = family
+    rng = np.random.default_rng(0)
+    eng = Engine(params, model=model, cfg=cfg, eos_id=EOS, **ENGINE_KW)
+    reqs = mixed_requests(rng, cfg, 6)
+    handles = [
+        eng.submit(p, max_new_tokens=m, key=seed) for p, m, seed in reqs
+    ]
+    eng.drain()
+    for (prompt, mnt, seed), h in zip(reqs, handles):
+        assert h.result() == solo(
+            model, cfg, params, prompt, seed, mnt, eos=EOS
+        ), f"request {seed} (prompt_len={len(prompt)}, max_new={mnt})"
+    assert eng.allocator.num_in_use == 0, "blocks leaked after drain"
+
+
+def test_engine_sampled_token_identical(family):
+    model, cfg, params = family
+    rng = np.random.default_rng(1)
+    eng = Engine(
+        params, model=model, cfg=cfg, eos_id=EOS,
+        temperature=0.8, top_k=20, **ENGINE_KW,
+    )
+    reqs = mixed_requests(rng, cfg, 6)
+    handles = [
+        eng.submit(p, max_new_tokens=m, key=100 + seed)
+        for p, m, seed in reqs
+    ]
+    eng.drain()
+    for (prompt, mnt, seed), h in zip(reqs, handles):
+        assert h.result() == solo(
+            model, cfg, params, prompt, 100 + seed, mnt, eos=EOS,
+            temperature=0.8, top_k=20,
+        ), f"request {seed}"
+    assert eng.allocator.num_in_use == 0
+
+
+def test_engine_streaming_is_incremental():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    h = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=12, key=0)
+    it = h.tokens()
+    first = next(it)
+    assert isinstance(first, int)
+    assert not h.done, "handle finished before its budget was streamed"
+    rest = list(it)
+    assert [first] + rest == solo(
+        llama, cfg, params, np.arange(1, 9, dtype=np.int32), 0, 12
+    )
+
+
+def test_engine_backpressure_not_crash():
+    """A pool sized for ~one request at a time: admission waits, nothing
+    crashes, every request completes, nothing leaks."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    before = telemetry.counter("serve.backpressure").value
+    eng = Engine(
+        params, model=llama, cfg=cfg, num_slots=4, block_size=8,
+        num_blocks=5, max_model_len=32, decode_chunk=2,
+    )
+    handles = [
+        eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=i)
+        for i in range(3)
+    ]
+    eng.drain()
+    assert all(len(h.result()) == 8 for h in handles)
+    assert telemetry.counter("serve.backpressure").value > before
+    assert eng.allocator.num_in_use == 0
+
+
+def test_engine_rejects_oversized_request():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(
+        params, model=llama, cfg=cfg, num_slots=1, block_size=8,
+        max_model_len=32,
+    )
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.submit(np.zeros(30, np.int32), max_new_tokens=30)
+    eng2 = Engine(
+        params, model=llama, cfg=cfg, num_slots=1, block_size=8,
+        num_blocks=3, max_model_len=32,
+    )
+    with pytest.raises(ValueError, match="num_blocks"):
+        eng2.submit(np.zeros(20, np.int32), max_new_tokens=10)
+
+
+def test_engine_fault_nan_skips_and_stays_token_identical():
+    """TDX_FAULT serve.step:nan: the poisoned chunk is skipped (counted),
+    the engine drains, and — decode being pure — output is unchanged."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    skipped_before = telemetry.counter("serve.skipped_steps").value
+    admit_before = telemetry.counter("serve.admit_retries").value
+    faults.reset("serve.step:2:nan,serve.admit:2:io")
+    try:
+        eng = Engine(params, model=llama, cfg=cfg, eos_id=EOS, **ENGINE_KW)
+        prompts = [np.arange(1, 7, dtype=np.int32) + i for i in range(3)]
+        handles = [
+            eng.submit(p, max_new_tokens=9, key=i)
+            for i, p in enumerate(prompts)
+        ]
+        eng.drain()
+    finally:
+        faults.reset("")
+    for i, (p, h) in enumerate(zip(prompts, handles)):
+        assert h.result() == solo(llama, cfg, params, p, i, 9, eos=EOS)
+    assert telemetry.counter("serve.skipped_steps").value == skipped_before + 1
+    assert telemetry.counter("serve.admit_retries").value == admit_before + 1
+
+
+def test_engine_failed_prefill_frees_reservation(monkeypatch):
+    """A prefill that raises (compile error, device OOM) must return the
+    request's page reservation before the error surfaces — otherwise a
+    few such failures drive the engine into permanent backpressure."""
+    import torchdistx_tpu.serving.engine as eng_mod
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected prefill failure")
+
+    monkeypatch.setattr(eng_mod, "_prefill", boom)
+    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
+    with pytest.raises(RuntimeError, match="injected prefill"):
+        eng.step()
+    assert eng.allocator.num_in_use == 0, "failed prefill leaked pages"
+    assert eng.allocator.num_free == eng.allocator.capacity
+
+
+def test_engine_recovers_lost_donated_cache(monkeypatch):
+    """The compiled prefill/decode calls hold the page pool DONATED: a
+    failure that consumed the buffers must fail the in-flight requests
+    loudly (their KV is gone — a silent truncated stream would read as a
+    short completion), free their pages, and install a fresh pool so new
+    requests keep being served."""
+    import torchdistx_tpu.serving.engine as eng_mod
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    h1 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
+    eng.step()  # h1 admitted + first decode chunk
+    assert not h1.done
+
+    real = eng_mod._decode_chunk
+
+    def consume_and_die(params_, paged, *a, **k):
+        for leaf in jax.tree.leaves(paged):
+            leaf.delete()  # what a real on-device failure does to a donation
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(eng_mod, "_decode_chunk", consume_and_die)
+    with pytest.raises(RuntimeError, match="injected device failure"):
+        eng.step()
+    monkeypatch.setattr(eng_mod, "_decode_chunk", real)
+
+    # In-flight request aborted loudly; nothing leaked.
+    assert h1.done and h1.error is not None
+    with pytest.raises(RuntimeError, match="aborted"):
+        list(h1.tokens())
+    assert eng.allocator.num_in_use == 0
+    # The engine is still servable, token-identically.
+    h2 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=3)
+    eng.drain()
+    assert h2.result() == solo(
+        llama, cfg, params, np.arange(1, 9, dtype=np.int32), 3, 8
+    )
+
+
+def test_engine_fault_fatal_propagates():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    faults.reset("serve.step:1:fatal")
+    try:
+        eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+        eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=4, key=0)
+        with pytest.raises(faults.FatalInjectedFault):
+            eng.drain()
+    finally:
+        faults.reset("")
+
+
+def test_engine_stats_and_telemetry_spans():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prev = telemetry.configure(collect=True)
+    try:
+        eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+        for i in range(3):
+            eng.submit(
+                np.arange(1, 6, dtype=np.int32), max_new_tokens=6, key=i
+            )
+        eng.drain()
+        st = eng.stats()
+        assert st["requests"] == 3 and st["running"] == 0
+        assert st["decode_tokens_per_s"] > 0
+        assert 0 <= st["ttft_p50_s"] <= st["ttft_p95_s"]
+        names = {s["name"] for s in telemetry.snapshot()["spans"]}
+        assert {"serve.prefill", "serve.step"} <= names
+    finally:
+        telemetry.configure(**prev)

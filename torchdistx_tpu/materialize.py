@@ -1406,6 +1406,34 @@ def materialize_module_jax(
         raise
 
 
+def _replicate_mesh_args(all_args, mesh):
+    """Explicitly place host argument leaves for mesh-lowered executables.
+
+    Mesh-job programs are lowered from host numpy leaves, and calling
+    them back with those raw leaves leans on ``Compiled.__call__``'s
+    input-sharding tolerance — which for committed/host arrays against
+    mesh-lowered programs is JAX-version-dependent (advisor r4, VERDICT
+    item 8b).  A replicated ``NamedSharding`` placement IS the layout
+    the executables were lowered for, on every version.  One batched
+    ``device_put`` for all leaves; non-array leaves pass through.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _P
+
+    rep = NamedSharding(mesh, _P())
+    leaves, treedef = jax.tree.flatten(all_args)
+    idx = [
+        i for i, x in enumerate(leaves)
+        if isinstance(x, (np.ndarray, jax.Array))
+    ]
+    placed = jax.device_put([leaves[i] for i in idx], rep)
+    for i, arr in zip(idx, placed):
+        leaves[i] = arr
+    return jax.tree.unflatten(treedef, leaves)
+
+
 def _materialize_module_jax(
     module: nn.Module,
     *,
@@ -2058,11 +2086,13 @@ def _materialize_module_jax(
         # unpack on device with a small exec-cached program (slice +
         # reshape is free for XLA).
         #
-        # Single-device runs only: that is where the per-RPC cost lives
-        # (the tunneled chip), and it keeps mesh executables fed with the
-        # exact host-numpy leaves they were lowered for — Compiled.__call__
-        # input-sharding tolerance for committed single-device arrays
-        # against mesh-lowered programs is version-dependent (advisor r4).
+        # The argpack applies to single-device runs only — that is where
+        # the per-RPC cost lives (the tunneled chip).  Mesh jobs instead
+        # get their host leaves explicitly placed as mesh-replicated
+        # arrays (the elif below): Compiled.__call__ input-sharding
+        # tolerance for committed single-device arrays against
+        # mesh-lowered programs is version-dependent (advisor r4), so we
+        # hand them the placement they were lowered for.
         all_args = [args for _, _, args, _ in jobs]
         if jobs and mesh is None:
             _sp_transfer = _telemetry.start_span("materialize.transfer")
@@ -2094,6 +2124,13 @@ def _materialize_module_jax(
                     for i in by_dtype[dt]:
                         leaves[i] = next(unpacked)
             all_args = jax.tree.unflatten(treedef, leaves)
+            last_profile.setdefault("transfer_s", _sp_transfer.end())
+        elif jobs:
+            # Mesh jobs: hand the executables explicitly mesh-replicated
+            # inputs rather than raw host leaves (VERDICT item 8b — see
+            # _replicate_mesh_args).
+            _sp_transfer = _telemetry.start_span("materialize.transfer")
+            all_args = _replicate_mesh_args(all_args, mesh)
             last_profile.setdefault("transfer_s", _sp_transfer.end())
         last_profile.setdefault("transfer_s", 0.0)
         _sp_exec = (

@@ -53,7 +53,9 @@ def generate(
     """Generate ``max_new_tokens`` continuations of ``prompt (B, S)``.
 
     Returns ``(B, max_new_tokens)`` int32 tokens.  After ``eos_id`` (if
-    given) a sequence keeps emitting ``eos_id``.
+    given) a sequence keeps emitting ``eos_id``; once EVERY sequence is
+    done the remaining decode steps skip the model forward entirely
+    (``lax.cond`` early exit) and just emit the eos fill.
     """
     b, s = prompt.shape
     total = s + max_new_tokens
@@ -78,8 +80,7 @@ def generate(
         first == eos_id if eos_id is not None else jnp.zeros((b,), bool)
     )
 
-    def step(carry, i):
-        tok, cache, done = carry
+    def live_step(tok, cache, done, i):
         logits, cache = model.forward_cached(
             params, tok[:, None], cfg, cache, s + i
         )
@@ -89,7 +90,27 @@ def generate(
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
-        return (nxt, cache, done), nxt
+        return nxt, cache, done
+
+    def step(carry, i):
+        tok, cache, done = carry
+        if eos_id is None:
+            tok, cache, done = live_step(tok, cache, done, i)
+            return (tok, cache, done), tok
+        # All-done early exit: once every sequence has hit eos, the
+        # remaining scan iterations emit eos WITHOUT paying the model
+        # forward (lax.cond executes one branch on TPU; the drained
+        # branch is a fill).  Token semantics are unchanged — the old
+        # code's where(done, eos, _) forced eos for exactly these steps.
+        tok, cache, done = jax.lax.cond(
+            done.all(),
+            lambda tok, cache, done, i: (
+                jnp.full_like(tok, eos_id), cache, done
+            ),
+            live_step,
+            tok, cache, done, i,
+        )
+        return (tok, cache, done), tok
 
     (_, _, _), rest = jax.lax.scan(
         step, (first, cache, done0), jnp.arange(max_new_tokens - 1)

@@ -32,6 +32,7 @@ __all__ = [
     "num_params",
     "init_cache",
     "forward_cached",
+    "forward_paged",
     "pp_pieces",
     "pp_value_and_grad",
 ]
@@ -359,6 +360,66 @@ def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
             jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
             jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
             pos,
+        ).reshape(b, t, -1)
+        x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
+            "bias"
+        ].astype(cfg.dtype)
+        h = _layernorm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.norm_eps)
+        h = jax.nn.gelu(
+            h @ lp["mlp_fc"]["weight"] + lp["mlp_fc"]["bias"].astype(cfg.dtype)
+        )
+        x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"]["bias"].astype(
+            cfg.dtype
+        )
+        return (x, kc, vc), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        block,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+    )
+    return _head_logits(params, x, cfg), {"k": new_k, "v": new_v}
+
+
+def forward_paged(params, tokens, cfg: GPT2Config, cache, block_tables,
+                  positions):
+    """One decode step against a paged KV cache — per-slot positions
+    (see :func:`llama.forward_paged`; GPT-2: learned positional embeds,
+    pre-LN biases, no GQA)."""
+    from ..ops.attention import paged_attention, paged_write_index
+
+    b, t = tokens.shape
+    if t != 1:
+        # One-token page scatter, as in llama.forward_paged.
+        raise ValueError(f"forward_paged decodes one token per slot (t={t})")
+    pos_ids = positions[:, None] + jnp.arange(t)[None]
+    x = jnp.take(params["wte"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    x = x + jnp.take(params["wpe"]["weight"], pos_ids, axis=0).astype(
+        cfg.dtype
+    )
+    blk, off = paged_write_index(
+        block_tables, positions, cache["k"].shape[2]
+    )
+
+    def block(carry, layer):
+        x, kc, vc = carry
+        lp, i = layer
+        h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
+        qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
+            cfg.dtype
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        kc = kc.at[i, blk, off].set(k[:, 0])
+        vc = vc.at[i, blk, off].set(v[:, 0])
+        attn = paged_attention(
+            q,
+            jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
+            block_tables,
+            positions,
         ).reshape(b, t, -1)
         x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
             "bias"

@@ -53,6 +53,7 @@ __all__ = [
     "num_params",
     "init_cache",
     "forward_cached",
+    "forward_paged",
     "prep_decode",
     "pp_pieces",
     "pp_value_and_grad",
@@ -566,6 +567,87 @@ def forward_cached(params, tokens, cfg: LlamaConfig, cache, pos):
             jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
             jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
             pos,
+        )
+        x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gu = h @ lp["wgu"]
+        gated = jax.nn.silu(gu[..., : cfg.ffn_dim]) * gu[..., cfg.ffn_dim:]
+        x = x + gated @ lp["w_down"]
+        return (x, kc, vc), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        block,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)),
+    )
+    return _head_logits(params, x, cfg), {"k": new_k, "v": new_v}
+
+
+def forward_paged(params, tokens, cfg: LlamaConfig, cache, block_tables,
+                  positions):
+    """One decode step against a block/paged KV cache (the serving path).
+
+    ``tokens (B, 1)`` holds each slot's current token at its OWN position
+    ``positions (B,)`` — unlike :func:`forward_cached`, whose scalar ``pos``
+    forces every batch row to the same depth, so it cannot serve a
+    continuously batched decode where slots admit and retire independently.
+    ``cache`` is the paged pool ``{"k","v"}: (L, NB, bs, Hkv, Dh)`` and
+    ``block_tables (B, M)`` maps slot-logical blocks to pages (see
+    :mod:`torchdistx_tpu.serving`).
+
+    Returns ``(logits (B, 1, V) f32, new cache)``.  Same fused-weight layer
+    scan as :func:`forward_cached` (prep_decode applies; caches ride the
+    scan carry), with the slice write/read swapped for a page scatter and
+    the block-table gather of :func:`ops.attention.paged_attention` —
+    values match the contiguous path exactly.
+
+    A slot whose ``positions[b]`` has run past its table (``pos//bs >= M``)
+    scatters into page 0 — the trash page the serving engine never hands
+    out — so a retired-but-still-batched slot can never corrupt a live
+    slot's cache.
+    """
+    from ..ops.attention import paged_attention, paged_write_index
+
+    if "wqkv" not in params["layers"]:
+        params = prep_decode(params, cfg)
+    b, t = tokens.shape
+    if t != 1:
+        # The page scatter below writes ONE token per slot; a t>1 call
+        # would silently drop the rest and attend to zeroed KV.
+        raise ValueError(f"forward_paged decodes one token per slot (t={t})")
+    x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    n_q = cfg.n_heads * cfg.head_dim
+    n_kv = cfg.n_kv_heads * cfg.head_dim
+    cos, sin = _rope_tables(
+        positions[:, None] + jnp.arange(t)[None],
+        cfg.rope_theta, cfg.head_dim // 2, cfg.dtype,
+    )
+    blk, off = paged_write_index(
+        block_tables, positions, cache["k"].shape[2]
+    )
+
+    def block(carry, layer):
+        x, kc, vc = carry
+        lp, i = layer
+        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        qkv = h @ lp["wqkv"]
+        q = qkv[..., :n_q].reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = qkv[..., n_q:n_q + n_kv].reshape(
+            b, t, cfg.n_kv_heads, cfg.head_dim
+        )
+        v = qkv[..., n_q + n_kv:].reshape(
+            b, t, cfg.n_kv_heads, cfg.head_dim
+        )
+        q = _rope_apply(q, cos, sin)
+        k = _rope_apply(k, cos, sin)
+        kc = kc.at[i, blk, off].set(k[:, 0])
+        vc = vc.at[i, blk, off].set(v[:, 0])
+        attn = paged_attention(
+            q,
+            jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
+            block_tables,
+            positions,
         )
         x = x + attn.reshape(b, t, -1) @ lp["wo"]
         h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -23,7 +23,13 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
-__all__ = ["attention", "mha_reference"]
+__all__ = [
+    "attention",
+    "cached_attention",
+    "mha_reference",
+    "paged_attention",
+    "paged_write_index",
+]
 
 
 def _neg_inf(dtype):
@@ -65,6 +71,32 @@ def mha_reference(q, k, v, *, causal: bool = True, segment_ids=None):
     return out.reshape(b, sq, hq, d)
 
 
+def _attend_cached(q, k_cache, v_cache, valid):
+    """Shared decode-attention math: GQA einsum + f32 softmax over a cache.
+
+    ``valid`` broadcasts against the f32 logits ``(B, T, Hkv, G, Sk)``.
+    One definition for the contiguous (:func:`cached_attention`) and paged
+    (:func:`paged_attention`) cache layouts — identical contraction and
+    masking ops, so serving logits cannot drift from the generate path.
+    """
+    import jax.numpy as jnp
+
+    b, t, hq, d = q.shape
+    hkv = k_cache.shape[2]
+    groups = hq // hkv
+    qg = q.reshape(b, t, hkv, groups, d)
+    scale = 1.0 / (d**0.5)
+    logits = (
+        jnp.einsum("bqhgd,bkhd->bqhgk", qg, k_cache).astype(jnp.float32)
+        * scale
+    )
+    logits = jnp.where(valid, logits, _neg_inf(jnp.float32))
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bqhgk,bkhd->bqhgd", probs.astype(v_cache.dtype), v_cache)
+    return out.reshape(b, t, hq, d)
+
+
 def cached_attention(q, k_cache, v_cache, pos):
     """Decode-time attention against a static-shape KV cache.
 
@@ -76,23 +108,70 @@ def cached_attention(q, k_cache, v_cache, pos):
     """
     import jax.numpy as jnp
 
-    b, t, hq, d = q.shape
-    smax, hkv = k_cache.shape[1], k_cache.shape[2]
-    groups = hq // hkv
-    qg = q.reshape(b, t, hkv, groups, d)
-    scale = 1.0 / (d**0.5)
-    logits = (
-        jnp.einsum("bqhgd,bkhd->bqhgk", qg, k_cache).astype(jnp.float32)
-        * scale
-    )
+    t = q.shape[1]
+    smax = k_cache.shape[1]
     valid = jnp.arange(smax)[None, :] <= (pos + jnp.arange(t))[:, None]
-    logits = jnp.where(
-        valid[None, :, None, None, :], logits, _neg_inf(jnp.float32)
+    return _attend_cached(
+        q, k_cache, v_cache, valid[None, :, None, None, :]
     )
-    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
-    probs = probs / probs.sum(axis=-1, keepdims=True)
-    out = jnp.einsum("bqhgk,bkhd->bqhgd", probs.astype(v_cache.dtype), v_cache)
-    return out.reshape(b, t, hq, d)
+
+
+def paged_write_index(block_tables, positions, block_size):
+    """Page/offset each slot's CURRENT token writes to: ``(blk, off)``,
+    both ``(B,)`` int32.
+
+    The ONE definition of the paged cache's write-steering rule, shared
+    by every family's ``forward_paged`` (llama, gpt2) and the prefill
+    scatter (``serving.cache.write_prompt``, table broadcast per
+    position) — it is safety-critical for cache isolation, so it must
+    not fork per call site:
+    a slot whose position has run past its table (``pos//bs >= M``)
+    steers into page 0, the trash page the serving allocator never hands
+    out (:data:`torchdistx_tpu.serving.blocks.TRASH_BLOCK`), so a
+    retired-but-still-batched slot can never scribble on a live slot's
+    pages.
+    """
+    import jax.numpy as jnp
+
+    m = block_tables.shape[1]
+    blk_no = positions // block_size
+    blk = jnp.take_along_axis(
+        block_tables, jnp.clip(blk_no, 0, m - 1)[:, None], axis=1
+    )[:, 0]
+    blk = jnp.where(blk_no < m, blk, 0)
+    return blk, positions % block_size
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, positions):
+    """Decode-time attention against a block/paged KV cache (serving path).
+
+    q ``(B, T, Hq, D)`` holds slot ``b``'s queries for positions
+    ``positions[b] .. positions[b]+T-1``; ``k_pages``/``v_pages``
+    ``(NB, bs, Hkv, D)`` are the one-layer page pools; ``block_tables``
+    ``(B, M)`` int32 maps slot ``b``'s logical block ``j`` to its page.
+    Gathers each slot's pages into a contiguous ``(B, M*bs, Hkv, D)`` view
+    and reuses :func:`_attend_cached` with the per-slot causal mask
+    ``key j <= positions[b] + i`` — pages beyond a slot's history (and the
+    shared trash page other slots scribble on) mask to exactly-zero
+    probability, so values match the contiguous-cache path bit-for-bit.
+
+    The gather reads ``M*bs`` positions per slot; size ``M`` (the engine's
+    ``max_model_len``) to the longest admissible request, NOT the model's
+    ``max_seq_len`` — that width, not the pool size, is the decode-step
+    HBM traffic.
+    """
+    import jax.numpy as jnp
+
+    b, t = q.shape[0], q.shape[1]
+    nb, bs, hkv, d = k_pages.shape
+    m = block_tables.shape[1]
+    k = jnp.take(k_pages, block_tables, axis=0).reshape(b, m * bs, hkv, d)
+    v = jnp.take(v_pages, block_tables, axis=0).reshape(b, m * bs, hkv, d)
+    valid = (
+        jnp.arange(m * bs)[None, None, :]
+        <= (positions[:, None] + jnp.arange(t)[None, :])[:, :, None]
+    )
+    return _attend_cached(q, k, v, valid[:, :, None, None, :])
 
 
 @functools.lru_cache(maxsize=1)

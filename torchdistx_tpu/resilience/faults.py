@@ -17,6 +17,12 @@ Sites (where the stack asks):
   retry re-enters the site and succeeds once the spec is consumed).
 * ``data.next``  — in ``fit()`` before pulling the next batch.
 * ``step.exec``  — in ``fit()`` before executing the step.
+* ``serve.admit`` — in the serving engine's admission phase, before any
+  request is popped or any page allocated (step = admission attempt;
+  ``nan`` skips the admission tick).
+* ``serve.step``  — before the serving engine dispatches a decode chunk
+  (step = decode-chunk number).  ``nan`` here means "this chunk is
+  poisoned": the engine skips it cleanly and re-runs next tick.
 
 Kinds (what happens):
 
@@ -28,9 +34,11 @@ Kinds (what happens):
   blocks, no atexit — the SIGKILL/power-loss simulation.
 * ``sigterm`` — ``os.kill(os.getpid(), SIGTERM)``: a real signal through
   the real handler — the preemption simulation.
-* ``nan``     — only meaningful at ``step.exec``: ``fit()`` poisons the
-  step's loss (via the reserved ``_tdx_nan`` batch key understood by
-  ``make_train_step``) so the jit-side non-finite guard trips.
+* ``nan``     — needs caller cooperation (returned, not raised).  At
+  ``step.exec``, ``fit()`` poisons the step's loss (via the reserved
+  ``_tdx_nan`` batch key understood by ``make_train_step``) so the
+  jit-side non-finite guard trips; at ``serve.step`` the serving engine
+  treats the decode chunk as poisoned and skips it.
 
 ``step`` is the 1-based global step number.  Each spec fires ONCE (the
 first time its site+step matches), so a retried site succeeds on the
@@ -60,7 +68,9 @@ __all__ = [
 
 ENV_VAR = "TDX_FAULT"
 CRASH_EXIT_CODE = 13
-SITES = frozenset({"ckpt.save", "data.next", "step.exec"})
+SITES = frozenset(
+    {"ckpt.save", "data.next", "step.exec", "serve.admit", "serve.step"}
+)
 KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan"})
 
 _T_FIRED = _telemetry.counter("faults.fired")

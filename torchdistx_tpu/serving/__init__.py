@@ -1,0 +1,48 @@
+"""Serving: continuous batching + paged KV cache + streaming scheduler.
+
+The inference-scaling subsystem (ROADMAP: "serves heavy traffic").  A
+solo :func:`~torchdistx_tpu.models.generate.generate` call is one batch
+that must finish together, with ``prompt + max_new_tokens`` cache
+allocated per row up front.  This package replaces that for serving:
+
+* :mod:`.blocks` — host-side page allocator (fixed-size KV pages,
+  admit/finish granularity, backpressure on exhaustion);
+* :mod:`.cache`  — the device page pools + the jitted prompt scatter;
+* :mod:`.engine` — the continuous-batching :class:`~.engine.Engine`
+  (one compiled decode chunk over fixed slots, per-bucket compiled
+  prefill, slot recycling at chunk boundaries);
+* :mod:`.scheduler` — FIFO admission, the prefill/decode interleave
+  knob, and the streaming :class:`~.scheduler.RequestHandle`.
+
+Quick start::
+
+    from torchdistx_tpu.serving import Engine
+    from torchdistx_tpu.models import llama
+
+    eng = Engine(params, model=llama, cfg=cfg, num_slots=8,
+                 block_size=16, eos_id=2)
+    h = eng.submit(prompt_ids, max_new_tokens=128, key=0)
+    for tok in h.tokens():      # streams; drives the engine
+        print(tok)
+
+Engine output is token-identical to solo ``generate`` with the same key
+(see :mod:`.engine`).  Telemetry: ``serve.*`` spans/counters/gauges
+(docs/observability.md); fault sites ``serve.admit`` / ``serve.step``
+(docs/resilience.md).  Full design: docs/serving.md.
+"""
+
+from .blocks import BlockAllocator, blocks_needed  # noqa: F401
+from .cache import init_paged_cache, write_prompt  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .scheduler import FIFOScheduler, Request, RequestHandle  # noqa: F401
+
+__all__ = [
+    "BlockAllocator",
+    "Engine",
+    "FIFOScheduler",
+    "Request",
+    "RequestHandle",
+    "blocks_needed",
+    "init_paged_cache",
+    "write_prompt",
+]

@@ -1,0 +1,100 @@
+"""Block allocator for the paged KV cache (vLLM-style, host-side).
+
+The device-side cache is a pool of ``num_blocks`` fixed-size pages per
+layer (see :mod:`.cache`); this module owns the *map*: which pages are
+free, which belong to which request.  All bookkeeping is host-side Python
+— allocation happens once per request admit/finish, never per token, so
+there is nothing to compile.
+
+Page 0 is the **trash page**: it is never handed out, and every masked
+write (prompt padding, retired-but-still-batched slots, position
+overshoot) is steered into it.  Scribbling on trash is safe by
+construction — the attention mask zeroes any read of a page outside a
+slot's own table (:func:`torchdistx_tpu.ops.attention.paged_attention`).
+
+Invariants (enforced, not assumed):
+
+* a page is owned by at most one request at a time (double-assignment
+  raises);
+* ``free()`` of a page not currently owned raises (double-free / stray
+  free);
+* exhaustion is a ``None`` return, not an exception — the scheduler turns
+  it into backpressure (the request waits in the FIFO).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import telemetry as _telemetry
+
+__all__ = ["BlockAllocator", "TRASH_BLOCK", "blocks_needed"]
+
+TRASH_BLOCK = 0
+
+_G_UTIL = _telemetry.gauge("serve.block_util")
+
+
+def blocks_needed(n_tokens: int, block_size: int) -> int:
+    """Pages needed to hold ``n_tokens`` cache slots."""
+    return -(-n_tokens // block_size)
+
+
+class BlockAllocator:
+    """Free-list allocator over pages ``1 .. num_blocks-1`` (0 is trash)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (page 0 is the trash page)")
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: recently-freed (still-warm) pages are reused
+        # first.  Deterministic: same admit/finish order → same tables.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._in_use: set = set()
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the trash page doesn't count)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        return len(self._in_use)
+
+    def utilization(self) -> float:
+        """Fraction of allocatable pages currently owned."""
+        return len(self._in_use) / self.capacity
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` pages, or ``None`` if fewer than ``n`` are free
+        (backpressure — never a partial grant)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for blk in out:
+            if blk in self._in_use or blk == TRASH_BLOCK:
+                raise RuntimeError(f"block allocator double-assigned page {blk}")
+            self._in_use.add(blk)
+        _G_UTIL.set(round(self.utilization(), 4))
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        """Return pages to the free list; freeing an unowned page raises."""
+        for blk in blocks:
+            if blk not in self._in_use:
+                raise RuntimeError(
+                    f"freeing page {blk} that is not in use (double free?)"
+                )
+            self._in_use.remove(blk)
+            self._free.append(blk)
+        _G_UTIL.set(round(self.utilization(), 4))

@@ -1,0 +1,75 @@
+"""Device-side paged KV cache: page pools + the prompt scatter.
+
+The pool is the model family's contiguous cache with the sequence axis cut
+into pages: ``{"k","v"}: (L, num_blocks, block_size, Hkv, Dh)``.  Shapes
+and dtype are probed from the family's own ``init_cache`` via
+``jax.eval_shape`` — zero model coupling, so any family implementing the
+cache protocol (llama, gpt2, future ones) pages identically.
+
+Two device programs live here:
+
+* :func:`init_paged_cache` — allocate the zeroed pool.
+* :func:`write_prompt` — scatter a *contiguous* prefill cache (what the
+  family's unchanged ``forward_cached`` produced for the padded prompt)
+  into a slot's pages.  Pad positions (``>= length``) and positions past
+  the table are steered into the trash page.  Jitted per prompt bucket;
+  the pool is donated so the scatter updates in place on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .blocks import TRASH_BLOCK
+
+__all__ = ["init_paged_cache", "write_prompt"]
+
+
+def init_paged_cache(model, cfg, num_blocks: int, block_size: int):
+    """Zeroed page pool ``{"k","v"}: (L, NB, bs, Hkv, Dh)`` for ``model``.
+
+    Dims/dtype come from ``jax.eval_shape(model.init_cache, ...)`` — no
+    allocation happens during the probe.
+    """
+    proto = jax.eval_shape(lambda: model.init_cache(cfg, 1, 1))
+
+    def page(leaf):
+        n_layers, _, _, heads, head_dim = leaf.shape
+        return jnp.zeros(
+            (n_layers, num_blocks, block_size, heads, head_dim),
+            dtype=leaf.dtype,
+        )
+
+    return jax.tree.map(page, proto)
+
+
+@partial(jax.jit, static_argnames=("block_size",), donate_argnums=(0,))
+def write_prompt(paged, contiguous, table, length, *, block_size: int):
+    """Scatter a slot's prefill KV into its pages.
+
+    ``paged``: the pool (donated); ``contiguous``: ``{"k","v"}:
+    (L, 1, P_pad, H, D)`` from the family's ``forward_cached`` prefill;
+    ``table (M,)`` int32 page table (padded with trash); ``length`` the
+    real prompt length (traced — one compile per ``P_pad`` bucket).
+    """
+    from ..ops.attention import paged_write_index
+
+    p_pad = jax.tree.leaves(contiguous)[0].shape[2]
+    pos = jnp.arange(p_pad)
+    # The ONE steering rule (see paged_write_index), with the prompt's
+    # shared table broadcast per position; pad positions go to trash.
+    blk, off = paged_write_index(
+        jnp.broadcast_to(table[None], (p_pad, table.shape[0])),
+        pos, block_size,
+    )
+    blk = jnp.where(pos < length, blk, TRASH_BLOCK)
+
+    def scatter(pool, cont):
+        # pool (L, NB, bs, H, D); cont[:, 0] (L, P, H, D): rows land at
+        # (layer, blk[p], off[p]).
+        return pool.at[:, blk, off].set(cont[:, 0])
+
+    return jax.tree.map(scatter, paged, contiguous)

@@ -1,0 +1,523 @@
+"""Continuous-batching engine: fixed decode slots over a paged KV cache.
+
+Orca-style iteration-level scheduling (Yu et al., OSDI '22) on top of a
+vLLM-style paged cache (Kwon et al., SOSP '23), specialized for the TPU
+idiom of this stack: **two compiled programs total** serve any traffic
+mix —
+
+* a jitted **prefill** per prompt-length bucket: the family's unchanged
+  ``forward_cached`` over the padded prompt, first-token sampling, and
+  the page scatter (:func:`.cache.write_prompt`), all one program;
+* ONE jitted **decode chunk**: ``decode_chunk`` steps of the family's
+  ``forward_paged`` over all ``num_slots`` slots, ``lax.scan``-fused so
+  the host syncs once per chunk, not once per token.
+
+Slots admit and retire independently — the moment a sequence hits EOS or
+its token budget (observed at the next chunk boundary), its pages free
+and the next FIFO request prefills into them.  No request ever waits for
+a batch-mate.
+
+**Token parity with solo** :func:`~torchdistx_tpu.models.generate.generate`
+is a correctness invariant, not an aspiration: the paged attention path
+masks exactly like the contiguous one, per-slot sampling keys are
+``fold_in(request_key, n_generated)`` (the same schedule ``generate``
+uses), and ``_sample`` is literally the same function — so an engine
+under out-of-order admission and mid-stream recycling emits the same
+tokens a solo call would.  ``tests/test_serving.py`` pins this, greedy
+and sampled.
+
+Sampling config (temperature/top_k/eos) is **engine-level static** — it
+is baked into the two compiled programs, exactly as it is baked into a
+``generate`` call.  Per-request knobs are prompt, budget, and key.
+
+Resilience: ``serve.admit`` and ``serve.step`` are ``TDX_FAULT`` sites.
+An ``io`` fault leaves state untouched and the tick retries; a ``nan``
+fault marks the decode chunk poisoned and the engine *skips* it (decode
+is a pure function of committed state, so the re-run next tick emits the
+identical tokens — the serving analog of the training loop's
+skip-step non-finite guard).  ``fatal`` propagates: fatal means fatal.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry as _telemetry
+from ..models.generate import _sample
+from ..resilience import faults
+from .blocks import BlockAllocator, blocks_needed
+from .cache import init_paged_cache, write_prompt
+from .scheduler import FIFOScheduler, Request, RequestHandle
+
+__all__ = ["Engine"]
+
+_T_REQUESTS = _telemetry.counter("serve.requests")
+_T_FINISHED = _telemetry.counter("serve.finished")
+_T_TOKENS = _telemetry.counter("serve.tokens_out")
+_T_ADMIT_RETRIES = _telemetry.counter("serve.admit_retries")
+_T_STEP_RETRIES = _telemetry.counter("serve.step_retries")
+_T_SKIPPED = _telemetry.counter("serve.skipped_steps")
+_G_RUNNING = _telemetry.gauge("serve.running_slots")
+_G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
+_G_TTFT = _telemetry.gauge("serve.ttft_s")
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model", "cfg", "temperature", "top_k", "block_size",
+    ),
+    donate_argnums=(1,),
+)
+def _prefill(
+    params, paged, prompt, length, key, table,
+    *, model, cfg, temperature, top_k, block_size,
+):
+    """Compiled prefill: contiguous forward over the padded prompt,
+    first-token sample (``fold_in(key, 0)`` — ``generate``'s schedule),
+    and the page scatter.  One compile per prompt bucket."""
+    p_pad = prompt.shape[1]
+    scratch = model.init_cache(cfg, 1, p_pad)
+    logits, scratch = model.forward_cached(params, prompt, cfg, scratch, 0)
+    last = jax.lax.dynamic_index_in_dim(
+        logits, length - 1, axis=1, keepdims=False
+    )
+    first = _sample(
+        last, jax.random.fold_in(key, 0), temperature, top_k
+    ).astype(jnp.int32)[0]
+    paged = write_prompt(paged, scratch, table, length, block_size=block_size)
+    return first, paged
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model", "cfg", "temperature", "top_k", "eos_id", "n_steps",
+    ),
+    donate_argnums=(1,),
+)
+def _decode_chunk(
+    params, paged, tokens, positions, n_gen, done, keys, block_tables,
+    *, model, cfg, temperature, top_k, eos_id, n_steps,
+):
+    """Compiled decode chunk: ``n_steps`` scan-fused ``forward_paged``
+    steps over every slot.  Post-EOS slots keep emitting EOS (solo
+    ``generate`` semantics); retired slots scribble on the trash page.
+    Returns ``(new paged cache, tokens (n_steps, S))``."""
+
+    def one(carry, _):
+        tok, cache, pos, n, dn = carry
+        logits, cache = model.forward_paged(
+            params, tok[:, None], cfg, cache, block_tables, pos
+        )
+        step_keys = jax.vmap(jax.random.fold_in)(keys, n)
+        nxt = jax.vmap(
+            lambda lg, k: _sample(lg[None], k, temperature, top_k)[0]
+        )(logits[:, -1], step_keys).astype(jnp.int32)
+        if eos_id is not None:
+            nxt = jnp.where(dn, eos_id, nxt)
+            dn = dn | (nxt == eos_id)
+        return (nxt, cache, pos + 1, n + 1, dn), nxt
+
+    (tok, paged, pos, n, dn), out = jax.lax.scan(
+        one, (tokens, paged, positions, n_gen, done), None, length=n_steps
+    )
+    return paged, out
+
+
+class Engine:
+    """Continuous-batching serving engine over one model family.
+
+    Single-host, single-threaded: drive it from ``handle.tokens()`` /
+    ``handle.result()`` / :meth:`drain`, or call :meth:`step` yourself.
+
+    Parameters
+    ----------
+    params : the family's parameter pytree (raw or ``prep_decode``-prepped;
+        prepped once at construction when the family supports it).
+    model / cfg : the family module + config (the ``generate`` protocol).
+    num_slots : decode batch width — concurrent running requests.
+    block_size : KV page size in tokens.
+    num_blocks : page-pool size; default reserves dense capacity
+        (``num_slots`` × the max request) so nothing backpressures unless
+        you size it down — sizing it down is the point of paging.
+    max_model_len : longest admissible ``prompt + max_new_tokens``; also
+        the block-table width, i.e. the decode attention span.  Keep it at
+        your real traffic's max, NOT ``cfg.max_seq_len``.
+    temperature / top_k / eos_id : engine-static sampling config.
+    decode_chunk : decode steps fused per host sync.  Recycling happens at
+        chunk boundaries, so large chunks trade slot-turnaround (and thus
+        a little throughput under churn) for far fewer host round-trips.
+    max_prefills_per_tick : the prefill/decode interleave knob
+        (see :class:`.scheduler.FIFOScheduler`).
+    """
+
+    def __init__(
+        self,
+        params,
+        *,
+        model,
+        cfg,
+        num_slots: int = 8,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        max_model_len: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        decode_chunk: int = 8,
+        max_prefills_per_tick: int = 1,
+        min_prefill_bucket: int = 16,
+    ):
+        self.model = model
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.block_size = block_size
+        self.max_model_len = int(max_model_len or cfg.max_seq_len)
+        if self.max_model_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_model_len ({self.max_model_len}) exceeds "
+                f"cfg.max_seq_len ({cfg.max_seq_len})"
+            )
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.decode_chunk = int(decode_chunk)
+        if self.decode_chunk < 1:
+            raise ValueError("decode_chunk must be >= 1")
+        self.min_prefill_bucket = int(min_prefill_bucket)
+        if self.min_prefill_bucket < 1:
+            # _bucket doubles up from this value; <= 0 would never
+            # terminate.
+            raise ValueError("min_prefill_bucket must be >= 1")
+
+        self._table_width = blocks_needed(self.max_model_len, block_size)
+        if num_blocks is None:
+            num_blocks = 1 + num_slots * self._table_width
+        self.allocator = BlockAllocator(num_blocks, block_size)
+        self.scheduler = FIFOScheduler(max_prefills_per_tick)
+
+        prep = getattr(model, "prep_decode", None)
+        self._params = prep(params, cfg) if prep is not None else params
+        self._cache = init_paged_cache(model, cfg, num_blocks, block_size)
+
+        s = num_slots
+        self._slot_req: list[Optional[Request]] = [None] * s
+        self._tokens = np.zeros((s,), np.int32)  # each slot's current token
+        self._positions = np.zeros((s,), np.int32)  # its next cache slot
+        self._n_gen = np.zeros((s,), np.int32)  # tokens sampled so far
+        self._done = np.ones((s,), bool)  # idle slots read as done
+        self._keys = np.zeros((s, 2), np.uint32)
+        self._tables = np.zeros((s, self._table_width), np.int32)
+        self._emitted = np.zeros((s,), np.int64)  # tokens pushed to handles
+
+        self._next_rid = 0
+        self._admit_no = 0  # admission attempts (serve.admit fault site)
+        self._decode_no = 0  # decode chunks attempted (serve.step site)
+        self._decode_s = 0.0
+        self._decode_tokens = 0
+        # Bounded: stats() reports percentiles over the most recent
+        # window, and a long-lived engine must not grow per-request state.
+        self._ttft = deque(maxlen=4096)
+
+    # ------------------------------------------------------------------
+    # Submission / draining
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        key: Any = None,
+    ) -> RequestHandle:
+        """Queue a request; returns its streaming handle.
+
+        ``key``: an int seed or a PRNG key array — the SAME key a solo
+        ``generate(params, prompt[None], key, ...)`` call would take, for
+        token parity.  Default: a key derived from the request id.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt) + max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens})"
+                f" = {total} exceeds max_model_len ({self.max_model_len})"
+            )
+        if blocks_needed(total, self.block_size) > self.allocator.capacity:
+            raise ValueError(
+                "request needs more pages than the engine owns "
+                f"({blocks_needed(total, self.block_size)} > "
+                f"{self.allocator.capacity}); raise num_blocks"
+            )
+        if key is None:
+            key = self._next_rid
+        if isinstance(key, int):
+            key = jax.random.PRNGKey(key)
+        key = np.asarray(key).astype(np.uint32).reshape(2)
+
+        rid = self._next_rid
+        self._next_rid += 1
+        handle = RequestHandle(self, rid)
+        self.scheduler.push(
+            Request(rid, prompt, int(max_new_tokens), key, handle)
+        )
+        _T_REQUESTS.add()
+        return handle
+
+    def drain(self) -> None:
+        """Step until every submitted request has finished."""
+        while len(self.scheduler) or self._n_running():
+            self.step()
+
+    def _n_running(self) -> int:
+        return sum(r is not None for r in self._slot_req)
+
+    # ------------------------------------------------------------------
+    # The engine tick
+
+    def step(self) -> None:
+        """One tick: admit + prefill (up to the interleave knob), then one
+        decode chunk over the running slots."""
+        self._admit_phase()
+        self._decode_phase()
+        _G_RUNNING.set(self._n_running())
+
+    def _admit_phase(self) -> None:
+        free_slots = [
+            i for i, r in enumerate(self._slot_req) if r is None
+        ]
+        if not free_slots or not len(self.scheduler):
+            return
+        self._admit_no += 1
+        try:
+            kind = faults.fire("serve.admit", self._admit_no)
+        except OSError:
+            # Transient admit failure: nothing was popped or allocated —
+            # the very next tick retries the same FIFO head.
+            _T_ADMIT_RETRIES.add()
+            return
+        if kind is not None:
+            # Cooperation kinds (nan) at this site mean "this admission
+            # tick is poisoned": skip it — a consumed spec that silently
+            # did nothing would defeat the registry's whole point.
+            _T_ADMIT_RETRIES.add()
+            return
+        batch = self.scheduler.pop_admissible(
+            len(free_slots), self.allocator, self.block_size
+        )
+        for req in batch:
+            slot = free_slots.pop(0)
+            self._prefill_into(slot, req)
+
+    def _prefill_into(self, slot: int, req: Request) -> None:
+        s = len(req.prompt)
+        blocks = self.allocator.alloc(
+            blocks_needed(req.cache_tokens, self.block_size)
+        )
+        if blocks is None:  # pop_admissible reserved cumulatively
+            raise RuntimeError("scheduler admitted past the free list")
+        req.blocks = blocks
+        bucket = self._bucket(s)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :s] = req.prompt
+        table = np.zeros((self._table_width,), np.int32)
+        table[: len(blocks)] = blocks
+        try:
+            with _telemetry.span(
+                "serve.prefill", slot=slot, prompt_len=s, bucket=bucket
+            ):
+                first, self._cache = _prefill(
+                    self._params, self._cache, padded, s, req.key, table,
+                    model=self.model, cfg=self.cfg,
+                    temperature=self.temperature, top_k=self.top_k,
+                    block_size=self.block_size,
+                )
+                first = int(first)
+        except BaseException:
+            # A failed prefill (compile error, device OOM) must not leak
+            # the reservation — pages go back before the error surfaces,
+            # or a few such failures drive the engine into permanent
+            # backpressure.  And because the call held the DONATED cache,
+            # a failure during execution may have consumed the pool:
+            # recover it (failing any in-flight requests whose KV died
+            # with it) so the engine stays servable.
+            self.allocator.free(blocks)
+            req.blocks = None
+            self._recover_lost_cache()
+            raise
+        req.handle.ttft_s = time.perf_counter() - req.submit_t
+        self._ttft.append(req.handle.ttft_s)
+        _G_TTFT.set(round(req.handle.ttft_s, 4))
+
+        self._slot_req[slot] = req
+        self._tokens[slot] = first
+        self._positions[slot] = s
+        self._n_gen[slot] = 1
+        self._done[slot] = False
+        self._keys[slot] = req.key
+        self._tables[slot] = table
+        self._emitted[slot] = 0
+        # _push_token retires immediately on a first-token EOS or a
+        # budget of one — the slot never enters the decode batch.
+        self._push_token(slot, first)
+
+    def _bucket(self, prompt_len: int) -> int:
+        """Prompt pad length: next power of two (one prefill compile per
+        bucket), capped at ``max_model_len``."""
+        b = self.min_prefill_bucket
+        while b < prompt_len:
+            b *= 2
+        return min(b, self.max_model_len)
+
+    def _decode_phase(self) -> None:
+        if not self._n_running():
+            return
+        self._decode_no += 1
+        try:
+            kind = faults.fire("serve.step", self._decode_no)
+        except OSError:
+            # Transient: state untouched, next tick re-runs the chunk —
+            # decode is pure, so the retry is token-identical.
+            _T_STEP_RETRIES.add()
+            return
+        if kind == "nan":
+            # Poisoned step: skip BEFORE dispatch (committed state is the
+            # prior state bit-identically — the serving analog of the
+            # train loop's skip-step guard), count it, keep going.
+            _T_SKIPPED.add()
+            return
+        sp = _telemetry.start_span(
+            "serve.step",
+            n_active=self._n_running(),
+            chunk=self.decode_chunk,
+        )
+        t0 = time.perf_counter()
+        try:
+            self._cache, out = _decode_chunk(
+                self._params, self._cache,
+                self._tokens, self._positions, self._n_gen, self._done,
+                self._keys, self._tables,
+                model=self.model, cfg=self.cfg,
+                temperature=self.temperature, top_k=self.top_k,
+                eos_id=self.eos_id, n_steps=self.decode_chunk,
+            )
+        except BaseException:
+            # The chunk held the donated cache; see _recover_lost_cache.
+            sp.cancel()
+            self._recover_lost_cache()
+            raise
+        out = np.asarray(out)  # (chunk, S) — the one host sync per chunk
+        dt = time.perf_counter() - t0
+        self._decode_s += dt
+
+        committed = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            for tok in out[:, slot]:
+                self._push_token(slot, int(tok))
+                committed += 1
+                if self._slot_req[slot] is None:  # retired mid-chunk
+                    break
+            else:
+                # Still running: roll the slot's device-visible state
+                # forward by the whole chunk (post-EOS/budget overshoot
+                # inside the chunk stays inside the slot's own pages).
+                self._tokens[slot] = out[-1, slot]
+                self._positions[slot] += self.decode_chunk
+                self._n_gen[slot] += self.decode_chunk
+        self._decode_tokens += committed
+        if self._decode_s > 0:
+            _G_DECODE_TPS.set(round(self._decode_tokens / self._decode_s, 1))
+        sp.end(tokens=committed)
+
+    def _push_token(self, slot: int, token: int) -> None:
+        """Commit one token to the slot's handle; retire on EOS/budget."""
+        req = self._slot_req[slot]
+        req.handle._push(token)
+        self._emitted[slot] += 1
+        _T_TOKENS.add()
+        if self._emitted[slot] >= req.max_new_tokens or (
+            self.eos_id is not None and token == self.eos_id
+        ):
+            self._retire(slot)
+
+    def _retire(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        self.allocator.free(req.blocks)
+        req.blocks = None
+        req.handle._finish()
+        _T_FINISHED.add()
+        self._clear_slot(slot)
+
+    def _clear_slot(self, slot: int) -> None:
+        self._slot_req[slot] = None
+        self._tokens[slot] = 0
+        self._positions[slot] = 0
+        self._n_gen[slot] = 0
+        self._done[slot] = True
+        self._tables[slot] = 0  # idle slots scribble on the trash page
+
+    def _recover_lost_cache(self) -> None:
+        """Restore servability after a compiled call that held the
+        DONATED page pool raised.
+
+        If the failure happened before execution (trace/compile error),
+        the donation was never consumed and this is a no-op.  If the
+        buffers are gone, every running request's KV died with them:
+        those requests are failed loudly (their handles raise — a silent
+        truncated stream would look like a short completion), their
+        pages freed, and a fresh zeroed pool installed so NEW requests
+        keep being served.
+        """
+        if not any(
+            isinstance(x, jax.Array) and x.is_deleted()
+            for x in jax.tree.leaves(self._cache)
+        ):
+            return
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self.allocator.free(req.blocks)
+            req.blocks = None
+            req.handle._fail(
+                "KV page pool lost to a failed device call"
+            )
+            self._clear_slot(slot)
+        self._cache = init_paged_cache(
+            self.model, self.cfg, self.allocator.num_blocks, self.block_size
+        )
+
+    # ------------------------------------------------------------------
+    # Introspection
+
+    def stats(self) -> dict:
+        """Host-side serving stats (TTFT percentiles, sustained decode)."""
+        out = {
+            "requests": self._next_rid,
+            "running": self._n_running(),
+            "waiting": len(self.scheduler),
+            "decode_tokens": self._decode_tokens,
+            "decode_s": round(self._decode_s, 4),
+            "block_utilization": round(self.allocator.utilization(), 4),
+        }
+        if self._decode_s > 0:
+            out["decode_tokens_per_s"] = round(
+                self._decode_tokens / self._decode_s, 1
+            )
+        if self._ttft:
+            t = np.asarray(self._ttft)
+            out["ttft_p50_s"] = round(float(np.percentile(t, 50)), 4)
+            out["ttft_p95_s"] = round(float(np.percentile(t, 95)), 4)
+        return out

@@ -1,0 +1,149 @@
+"""Request scheduler: FIFO admission, backpressure, streaming handles.
+
+The scheduler owns the *waiting* side of the engine: a FIFO of submitted
+requests, the prefill/decode interleave knob (``max_prefills_per_tick`` —
+how many prompts may be prefilled per engine tick before the decode batch
+runs; raising it favors TTFT, lowering it favors decode throughput), and
+the backpressure rule: admission is head-of-line — if the head request's
+page reservation does not fit the allocator's free list, nothing is
+admitted this tick and the FIFO waits (no out-of-order admission, no
+partial grants, no crash).
+
+:class:`RequestHandle` is the streaming API: ``handle.tokens()`` yields
+tokens as the engine produces them, *driving* the engine while the caller
+iterates — no background thread, so runs are deterministic and the engine
+is single-threaded by construction (document, don't lock).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from .blocks import BlockAllocator, blocks_needed
+
+__all__ = ["FIFOScheduler", "Request", "RequestHandle"]
+
+_T_BACKPRESSURE = _telemetry.counter("serve.backpressure")
+_G_QUEUE = _telemetry.gauge("serve.queue_depth")
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted unit of work (host-side bookkeeping only)."""
+
+    rid: int
+    prompt: np.ndarray  # (S,) int32
+    max_new_tokens: int
+    key: np.ndarray  # (2,) uint32 — the solo-generate-compatible PRNG key
+    handle: "RequestHandle"
+    submit_t: float = dataclasses.field(default_factory=time.perf_counter)
+    blocks: Optional[List[int]] = None  # pages owned while running
+
+    @property
+    def cache_tokens(self) -> int:
+        """KV slots this request reserves: every prompt + output position."""
+        return len(self.prompt) + self.max_new_tokens
+
+
+class RequestHandle:
+    """Streaming view of one request's output."""
+
+    def __init__(self, engine, rid: int):
+        self._engine = engine
+        self.rid = rid
+        self._tokens: List[int] = []
+        self._done = False
+        self.ttft_s: Optional[float] = None
+        self.error: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def _push(self, token: int) -> None:
+        self._tokens.append(token)
+
+    def _finish(self) -> None:
+        self._done = True
+
+    def _fail(self, msg: str) -> None:
+        """Abort the request (e.g. its KV was lost to a failed device
+        call): consumers see a ``RuntimeError`` instead of a silent
+        truncated stream."""
+        self.error = msg
+        self._done = True
+
+    def tokens(self) -> Iterator[int]:
+        """Yield tokens as they are produced, stepping the engine while
+        none are buffered.  Safe to interleave across handles — every
+        ``step()`` advances all running requests.  Raises if the request
+        was aborted."""
+        i = 0
+        while True:
+            while i < len(self._tokens):
+                yield self._tokens[i]
+                i += 1
+            if self._done:
+                if self.error is not None:
+                    raise RuntimeError(
+                        f"request {self.rid} aborted: {self.error}"
+                    )
+                return
+            self._engine.step()
+
+    def result(self) -> List[int]:
+        """Block (by stepping the engine) until done; return all tokens —
+        up to and including the first EOS, or ``max_new_tokens`` if EOS
+        never fires (solo ``generate()``'s output truncated the same way).
+        """
+        for _ in self.tokens():
+            pass
+        return list(self._tokens)
+
+
+class FIFOScheduler:
+    """FIFO admission with head-of-line backpressure."""
+
+    def __init__(self, max_prefills_per_tick: int = 1):
+        if max_prefills_per_tick < 1:
+            raise ValueError("max_prefills_per_tick must be >= 1")
+        self.max_prefills_per_tick = max_prefills_per_tick
+        self._waiting: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._waiting)
+
+    def push(self, req: Request) -> None:
+        self._waiting.append(req)
+        _G_QUEUE.set(len(self._waiting))
+
+    def pop_admissible(
+        self,
+        n_free_slots: int,
+        allocator: BlockAllocator,
+        block_size: int,
+    ) -> List[Request]:
+        """Pop up to ``max_prefills_per_tick`` requests that fit the free
+        slots AND whose cumulative page reservations fit the free list.
+        Stops at the first head that doesn't fit (FIFO order is the
+        fairness guarantee; skipping ahead would starve long prompts)."""
+        out: List[Request] = []
+        free_pages = allocator.num_free
+        while (
+            self._waiting
+            and len(out) < min(self.max_prefills_per_tick, n_free_slots)
+        ):
+            need = blocks_needed(self._waiting[0].cache_tokens, block_size)
+            if need > free_pages:
+                _T_BACKPRESSURE.add()
+                break
+            free_pages -= need
+            out.append(self._waiting.popleft())
+        _G_QUEUE.set(len(self._waiting))
+        return out
