@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Chaos soak: seeded randomized faults over the serving engine + a
+SIGTERM drain, with trace assertions (ISSUE 5 acceptance gate).
+
+Phase 1 — soak: a seeded fault schedule (``serve.admit`` /
+``serve.prefill`` / ``serve.step`` / ``serve.recover`` sites, io/nan
+kinds) plus seeded *device failures* (the donated page pool consumed
+mid-decode — the case TDX_FAULT cannot express, injected by wrapping the
+compiled decode chunk) runs under ≥200 mixed-length requests with random
+tiny deadlines and client cancels.  Every request must either complete
+**token-identical to solo generate()** or fail with a **typed**
+RequestError; the drive loop is bounded (a hang fails), the allocator
+must end with zero pages owned, and the engine must be back to READY.
+
+Phase 2 — drain: under live load, a real SIGTERM goes through the real
+handler chain.  The engine must reach STOPPED within the drain deadline,
+finishing in-flight work or failing it with a retryable typed error —
+completed streams are re-checked against solo generate() (no silent
+truncation).
+
+Finally the exported telemetry trace must record the recoveries: the
+``serve.recover`` and ``serve.drain`` spans and a
+``serve.recoveries >= 1`` counter snapshot.
+
+CI (.github/workflows/ci.yaml, chaos-soak job) runs this with
+``TDX_TELEMETRY`` set.  Locally:
+
+    TDX_TELEMETRY=/tmp/chaos.jsonl JAX_PLATFORMS=cpu \\
+    python scripts/chaos_soak.py
+"""
+
+import json
+import os
+import signal
+import sys
+
+# Runnable from a checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EOS = 5
+SEED = int(os.environ.get("TDX_CHAOS_SEED", "5"))
+N_REQUESTS = int(os.environ.get("TDX_CHAOS_REQUESTS", "200"))
+MAX_STEPS = 60 * N_REQUESTS
+
+
+def fail(msg: str) -> int:
+    print(f"chaos_soak: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    trace = os.environ.get("TDX_TELEMETRY", "")
+    if not trace:
+        print("chaos_soak: set TDX_TELEMETRY", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import torchdistx_tpu.serving.engine as eng_mod
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.models.generate import generate
+    from torchdistx_tpu.resilience import faults
+    from torchdistx_tpu.serving import Engine, Health, RequestError
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(SEED)
+
+    solo_cache = {}
+
+    def solo(prompt, key, max_new):
+        k = (prompt.tobytes(), key, max_new)
+        if k not in solo_cache:
+            toks = [
+                int(t) for t in np.asarray(
+                    generate(
+                        params, prompt[None], jax.random.PRNGKey(key),
+                        model=llama, cfg=cfg, max_new_tokens=max_new,
+                        eos_id=EOS,
+                    )
+                )[0]
+            ]
+            if EOS in toks:
+                toks = toks[: toks.index(EOS) + 1]
+            solo_cache[k] = toks
+        return solo_cache[k]
+
+    # Seeded fault schedule over every serving site.
+    specs = []
+    for site, hi, kinds in [
+        ("serve.admit", N_REQUESTS, ["io", "nan"]),
+        ("serve.prefill", N_REQUESTS, ["io", "nan"]),
+        ("serve.step", 4 * N_REQUESTS, ["io", "nan"]),
+        ("serve.recover", 6, ["io"]),
+    ]:
+        for step in rng.integers(1, hi, size=6):
+            specs.append(f"{site}:{int(step)}:{rng.choice(kinds)}")
+    schedule = ",".join(sorted(set(specs)))
+    faults.reset(schedule)
+
+    # Seeded DEVICE failures: consume the donated pool and raise — the
+    # supervisor must rebuild and replay token-identically.
+    real_decode = eng_mod._decode_chunk
+    fail_at = set(
+        int(x) for x in rng.integers(3, 3 * N_REQUESTS, size=5)
+    )
+    state = {"chunk": 0}
+
+    def flaky_decode(p, paged, *args, **kwargs):
+        state["chunk"] += 1
+        if state["chunk"] in fail_at:
+            for leaf in jax.tree.leaves(paged):
+                leaf.delete()
+            raise RuntimeError(f"chaos device failure at chunk {state['chunk']}")
+        return real_decode(p, paged, *args, **kwargs)
+
+    eng_mod._decode_chunk = flaky_decode
+
+    def make_engine():
+        return Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+            block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+            max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+        )
+
+    # ---------------- Phase 1: the soak ----------------
+    eng = make_engine()
+    reqs = []
+    budgets = (4, 8, 12)
+    for i in range(N_REQUESTS):
+        plen = int(rng.integers(3, 14))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        mnt = int(rng.choice(budgets))
+        deadline = None if rng.random() > 0.05 else 1e-6
+        h = eng.submit(prompt, max_new_tokens=mnt, key=i, deadline_s=deadline)
+        if rng.random() < 0.05:
+            h.cancel()
+        reqs.append((prompt, mnt, i, h))
+
+    for _ in range(MAX_STEPS):
+        if not (len(eng.scheduler) or eng._n_running()):
+            break
+        eng.step()
+    else:
+        return fail(f"soak did not drain within {MAX_STEPS} steps (hang)")
+
+    n_ok = n_typed = 0
+    for prompt, mnt, key, h in reqs:
+        if not h.done:
+            return fail(f"request {key} neither finished nor failed")
+        if h.error is not None:
+            if not isinstance(h.error, RequestError):
+                return fail(
+                    f"request {key} failed UNTYPED: {type(h.error).__name__}: "
+                    f"{h.error}"
+                )
+            n_typed += 1
+        else:
+            if h.result() != solo(prompt, key, mnt):
+                return fail(f"request {key} diverged from solo generate()")
+            n_ok += 1
+    if eng.allocator.num_in_use != 0:
+        return fail(f"soak leaked {eng.allocator.num_in_use} pages")
+    if eng.health() is not Health.READY:
+        return fail(f"engine health {eng.health()} != READY after soak")
+    if eng.stats()["recoveries"] < 1:
+        return fail("fault schedule produced no recovery events")
+    print(
+        f"chaos_soak: soak OK — {n_ok} token-identical, {n_typed} typed "
+        f"failures, {eng.stats()['recoveries']} recoveries "
+        f"(seed={SEED}, n={N_REQUESTS})"
+    )
+
+    # ---------------- Phase 2: SIGTERM drain under load ----------------
+    faults.reset("")
+    eng_mod._decode_chunk = real_decode
+    eng2 = make_engine()
+    dreqs = []
+    for i in range(12):
+        plen = int(rng.integers(3, 14))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        mnt = int(rng.choice(budgets))
+        h = eng2.submit(prompt, max_new_tokens=mnt, key=1000 + i)
+        dreqs.append((prompt, mnt, 1000 + i, h))
+    for _ in range(4):  # fill all four slots: real in-flight work to drain
+        eng2.step()
+    os.kill(os.getpid(), signal.SIGTERM)  # the REAL preemption path
+    steps = 0
+    while eng2.health() is not Health.STOPPED:
+        eng2.step()
+        steps += 1
+        if steps > MAX_STEPS:
+            return fail("drain did not reach STOPPED (hang)")
+    n_done = n_preempted = 0
+    for prompt, mnt, key, h in dreqs:
+        if not h.done:
+            return fail(f"drain left request {key} pending")
+        if h.error is None:
+            if h.result() != solo(prompt, key, mnt):
+                return fail(
+                    f"request {key} silently truncated by the drain"
+                )
+            n_done += 1
+        else:
+            if not (isinstance(h.error, RequestError) and h.error.retryable):
+                return fail(
+                    f"drained request {key} failed non-retryably: {h.error!r}"
+                )
+            n_preempted += 1
+    if eng2.allocator.num_in_use != 0:
+        return fail(f"drain leaked {eng2.allocator.num_in_use} pages")
+    print(
+        f"chaos_soak: drain OK — {n_done} completed in full, "
+        f"{n_preempted} failed retryable, STOPPED in {steps} ticks"
+    )
+
+    # ---------------- Trace assertions ----------------
+    telemetry.emit_counters()
+    spans, counters = set(), {}
+    with open(trace) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "span":
+                spans.add(rec["name"])
+            elif rec.get("type") == "counters":
+                counters.update(rec.get("values", {}))
+    missing = {"serve.recover", "serve.drain", "serve.prefill", "serve.step"} - spans
+    if missing:
+        return fail(f"trace missing spans {missing}")
+    if counters.get("serve.recoveries", 0) < 1:
+        return fail(
+            "trace shows no serve.recoveries "
+            f"({ {k: v for k, v in counters.items() if k.startswith('serve')} })"
+        )
+    print(
+        "chaos_soak: trace OK — recoveries="
+        f"{counters.get('serve.recoveries')}, "
+        f"shed={counters.get('serve.shed', 0)}, "
+        f"expired={counters.get('serve.expired', 0)}, "
+        f"preempted={counters.get('serve.preempted', 0)}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,16 +16,27 @@ from torchdistx_tpu import telemetry
 from torchdistx_tpu.models import gpt2, llama
 from torchdistx_tpu.models.generate import generate
 from torchdistx_tpu.ops.attention import cached_attention, paged_attention
-from torchdistx_tpu.resilience import faults
+from torchdistx_tpu.resilience import faults, preemption
 from torchdistx_tpu.serving import (
     BlockAllocator,
     Engine,
+    Health,
+    RecoveryFailed,
     blocks_needed,
     init_paged_cache,
     write_prompt,
 )
 
 EOS = 5
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption():
+    """Engines consume the process-wide preemption flag (graceful
+    drain); keep tests hermetic against leftovers in either direction."""
+    preemption.clear()
+    yield
+    preemption.clear()
 
 
 @pytest.fixture(scope="module", params=["llama", "gpt2"])
@@ -300,40 +311,75 @@ def test_engine_fault_nan_skips_and_stays_token_identical():
     assert telemetry.counter("serve.admit_retries").value == admit_before + 1
 
 
-def test_engine_failed_prefill_frees_reservation(monkeypatch):
+def test_engine_failed_prefill_frees_reservation_and_retries(monkeypatch):
     """A prefill that raises (compile error, device OOM) must return the
-    request's page reservation before the error surfaces — otherwise a
-    few such failures drive the engine into permanent backpressure."""
+    request's page reservation before anything else happens — otherwise
+    a few such failures drive the engine into permanent backpressure —
+    and the request goes back to the FIFO head under its recovery
+    budget: a persistent failure becomes a typed error, a transient one
+    is retried to a token-identical completion."""
     import torchdistx_tpu.serving.engine as eng_mod
 
     cfg = llama.llama_test()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    real = eng_mod._prefill
 
     def boom(*a, **k):
         raise RuntimeError("injected prefill failure")
 
+    # Persistent failure: every retry frees the reservation, and the
+    # budget (max_recoveries=2 → 3 attempts) ends in a typed failure,
+    # not a raise out of step() and not a hang.
     monkeypatch.setattr(eng_mod, "_prefill", boom)
-    eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
-    with pytest.raises(RuntimeError, match="injected prefill"):
+    h = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
+    for _ in range(3):
         eng.step()
-    assert eng.allocator.num_in_use == 0, "failed prefill leaked pages"
+        assert eng.allocator.num_in_use == 0, "failed prefill leaked pages"
+    assert h.done and isinstance(h.error, RecoveryFailed)
+    with pytest.raises(RecoveryFailed):
+        h.result()
     assert eng.allocator.num_free == eng.allocator.capacity
+
+    # Transient failure: one boom, then the real prefill — the retried
+    # request completes token-identical to solo generate.
+    flaky = {"left": 1}
+
+    def boom_once(*a, **k):
+        if flaky["left"]:
+            flaky["left"] -= 1
+            raise RuntimeError("injected prefill failure")
+        return real(*a, **k)
+
+    monkeypatch.setattr(eng_mod, "_prefill", boom_once)
+    h2 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=7)
+    eng.drain()
+    assert h2.result() == solo(
+        llama, cfg, params, np.arange(1, 9, dtype=np.int32), 7, 8
+    )
+    assert eng.allocator.num_in_use == 0
 
 
 def test_engine_recovers_lost_donated_cache(monkeypatch):
     """The compiled prefill/decode calls hold the page pool DONATED: a
-    failure that consumed the buffers must fail the in-flight requests
-    loudly (their KV is gone — a silent truncated stream would read as a
-    short completion), free their pages, and install a fresh pool so new
-    requests keep being served."""
+    failure that consumed the buffers takes every in-flight request's KV
+    with it.  The recovery supervisor must rebuild the pool and REPLAY
+    the live requests from their committed tokens — the fold_in(key,
+    n_gen) sampling schedule makes the continuation token-identical, so
+    the device failure is invisible in the token stream."""
     import torchdistx_tpu.serving.engine as eng_mod
 
     cfg = llama.llama_test()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
-    h1 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
-    eng.step()  # h1 admitted + first decode chunk
+    recoveries_before = telemetry.counter("serve.recoveries").value
+    eng = Engine(
+        params, model=llama, cfg=cfg, temperature=0.8, top_k=20,
+        **ENGINE_KW,
+    )
+    h1 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=16, key=0)
+    h2 = eng.submit(np.arange(2, 8, dtype=np.int32), max_new_tokens=9, key=1)
+    eng.step()  # both admitted?  (interleave knob admits one per tick)
+    eng.step()
     assert not h1.done
 
     real = eng_mod._decode_chunk
@@ -344,21 +390,47 @@ def test_engine_recovers_lost_donated_cache(monkeypatch):
         raise RuntimeError("injected device failure")
 
     monkeypatch.setattr(eng_mod, "_decode_chunk", consume_and_die)
-    with pytest.raises(RuntimeError, match="injected device failure"):
-        eng.step()
+    eng.step()  # supervised: no raise, pool rebuilt, live slots replayed
     monkeypatch.setattr(eng_mod, "_decode_chunk", real)
 
-    # In-flight request aborted loudly; nothing leaked.
-    assert h1.done and h1.error is not None
-    with pytest.raises(RuntimeError, match="aborted"):
-        list(h1.tokens())
-    assert eng.allocator.num_in_use == 0
-    # The engine is still servable, token-identically.
-    h2 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=3)
     eng.drain()
-    assert h2.result() == solo(
-        llama, cfg, params, np.arange(1, 9, dtype=np.int32), 3, 8
+    assert h1.result() == solo(
+        llama, cfg, params, np.arange(1, 9, dtype=np.int32), 0, 16,
+        temperature=0.8, top_k=20,
     )
+    assert h2.result() == solo(
+        llama, cfg, params, np.arange(2, 8, dtype=np.int32), 1, 9,
+        temperature=0.8, top_k=20,
+    )
+    assert telemetry.counter("serve.recoveries").value > recoveries_before
+    assert eng.allocator.num_in_use == 0
+    assert eng.health() is Health.READY
+
+
+def test_engine_recovery_budget_exhausts_typed(monkeypatch):
+    """A device failure that keeps recurring must not loop forever: each
+    recovery event charges the live requests' budgets, and exhaustion is
+    a typed RecoveryFailed — engine still servable, nothing leaked."""
+    import torchdistx_tpu.serving.engine as eng_mod
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(params, model=llama, cfg=cfg, max_recoveries=1, **ENGINE_KW)
+    h = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
+    eng.step()
+    assert not h.done
+
+    def die(params_, paged, *a, **k):
+        raise RuntimeError("persistent device failure")
+
+    monkeypatch.setattr(eng_mod, "_decode_chunk", die)
+    for _ in range(4):
+        if h.done:
+            break
+        eng.step()
+    assert h.done and isinstance(h.error, RecoveryFailed)
+    assert h.error.retryable
+    assert eng.allocator.num_in_use == 0
 
 
 def test_engine_fault_fatal_propagates():

@@ -1,0 +1,512 @@
+"""Serving request-lifecycle robustness (ISSUE 5).
+
+Deadlines, cancellation, overload shedding, graceful drain, and the
+crash-recovery supervisor: every way a request can fail is a typed
+:class:`~torchdistx_tpu.serving.RequestError` — never a hang, never a
+silently truncated stream — and the engine's health walks the
+STARTING→READY→(OVERLOADED)→DRAINING→STOPPED machine with zero leaked
+pages at every exit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.models.generate import generate
+from torchdistx_tpu.resilience import faults, preemption
+from torchdistx_tpu.serving import (
+    DeadlineExceeded,
+    Engine,
+    EngineDraining,
+    EngineOverloaded,
+    Health,
+    OverloadDetector,
+    RequestCancelled,
+    RequestError,
+    RequestPreempted,
+)
+
+EOS = 5
+ENGINE_KW = dict(num_slots=2, block_size=8, max_model_len=64, decode_chunk=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption():
+    preemption.clear()
+    yield
+    preemption.clear()
+    faults.reset("")
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def solo(model, cfg, params, prompt, seed, max_new, *, eos=None):
+    out = generate(
+        params, jnp.asarray(prompt)[None], jax.random.PRNGKey(seed),
+        model=model, cfg=cfg, max_new_tokens=max_new, eos_id=eos,
+    )
+    toks = [int(t) for t in np.asarray(out)[0]]
+    if eos is not None and eos in toks:
+        toks = toks[: toks.index(eos) + 1]
+    return toks
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Health state machine
+
+
+def test_health_starting_ready(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    assert eng.health() is Health.STARTING
+    assert eng.stats()["health"] == "starting"
+    eng.submit(prompt_of(4), max_new_tokens=2, key=0)
+    eng.drain()
+    assert eng.health() is Health.READY
+
+
+def test_drain_on_preemption_finishes_inflight(family):
+    """preemption.request() (the SIGTERM path's programmatic twin) must
+    close admission, fail the waiting queue with a retryable error,
+    finish the in-flight requests within the drain deadline, and land
+    STOPPED with zero pages owned."""
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, drain_deadline_s=60.0,
+                 **ENGINE_KW)
+    running = [
+        eng.submit(prompt_of(6, base=i + 1), max_new_tokens=6, key=i)
+        for i in range(2)
+    ]
+    eng.step()
+    eng.step()  # both admitted (interleave knob is 1/tick)
+    waiting = eng.submit(prompt_of(5), max_new_tokens=4, key=9)
+    preemption.request()
+    while eng.health() is not Health.STOPPED:
+        eng.step()
+    # In-flight work finished completely — token-identical, no truncation.
+    for i, h in enumerate(running):
+        assert h.result() == solo(
+            model, cfg, params, prompt_of(6, base=i + 1), i, 6
+        )
+    # The queued request was failed retryably, not silently dropped.
+    assert waiting.done and isinstance(waiting.error, RequestPreempted)
+    assert waiting.error.retryable
+    with pytest.raises(RequestPreempted):
+        waiting.result()
+    assert eng.allocator.num_in_use == 0
+    # A stopped engine refuses work, typed and retryable.
+    with pytest.raises(EngineDraining):
+        eng.submit(prompt_of(4), max_new_tokens=2, key=3)
+    with pytest.raises(EngineDraining):
+        eng.step()
+
+
+def test_drain_deadline_fails_remainder_retryable(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, drain_deadline_s=0.0,
+                 **ENGINE_KW)
+    h = eng.submit(prompt_of(6), max_new_tokens=30, key=0)
+    eng.step()
+    assert not h.done
+    preemption.request()
+    eng.step()  # drain begins; deadline 0 → the remainder fails now
+    assert eng.health() is Health.STOPPED
+    assert h.done and isinstance(h.error, RequestPreempted)
+    assert h.error.retryable
+    assert eng.allocator.num_in_use == 0
+
+
+def test_drain_emits_span_and_counters(family):
+    model, cfg, params = family
+    prev = telemetry.configure(collect=True)
+    try:
+        eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        eng.submit(prompt_of(4), max_new_tokens=4, key=0)
+        eng.step()
+        preemption.request()
+        while eng.health() is not Health.STOPPED:
+            eng.step()
+        names = {s["name"] for s in telemetry.snapshot()["spans"]}
+        assert "serve.drain" in names
+        assert telemetry.gauge("serve.health").value == "stopped"
+    finally:
+        telemetry.configure(**prev)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+
+
+def test_deadline_expires_queued_request(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    before = telemetry.counter("serve.expired").value
+    # Two requests occupy both slots; the third has an already-tiny
+    # deadline and must expire in the queue, typed, pages never taken.
+    keep = [
+        eng.submit(prompt_of(6, base=i + 1), max_new_tokens=6, key=i)
+        for i in range(2)
+    ]
+    doomed = eng.submit(
+        prompt_of(5), max_new_tokens=4, key=9, deadline_s=1e-6
+    )
+    eng.drain()
+    assert doomed.done and isinstance(doomed.error, DeadlineExceeded)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result()
+    for i, h in enumerate(keep):
+        assert h.result() == solo(
+            model, cfg, params, prompt_of(6, base=i + 1), i, 6
+        )
+    assert telemetry.counter("serve.expired").value > before
+    assert eng.allocator.num_in_use == 0
+
+
+def test_deadline_expires_running_request_releases_pages(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    h = eng.submit(
+        prompt_of(6), max_new_tokens=40, key=0, deadline_s=60.0
+    )
+    eng.step()  # admitted, pages owned, mid-stream
+    assert not h.done and eng.allocator.num_in_use > 0
+    # Force the expiry deterministically (wall-clock sleeps are flaky).
+    eng._slot_req[0].deadline = 0.0
+    eng.step()  # next chunk boundary: expiry observed, pages released
+    assert h.done and isinstance(h.error, DeadlineExceeded)
+    assert eng.allocator.num_in_use == 0
+    assert eng.health() is Health.READY
+
+
+def test_deadline_validation(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    with pytest.raises(ValueError, match="deadline_s"):
+        eng.submit(prompt_of(4), max_new_tokens=2, key=0, deadline_s=0)
+
+
+# ---------------------------------------------------------------------------
+# Cancellation
+
+
+def test_cancel_queued_and_running(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    before = telemetry.counter("serve.cancelled").value
+    run = eng.submit(prompt_of(6), max_new_tokens=30, key=0)
+    eng.step()
+    assert not run.done
+    queued = eng.submit(prompt_of(5), max_new_tokens=4, key=1)
+    assert run.cancel() and queued.cancel()
+    eng.step()  # next chunk boundary: both leave, pages released
+    assert run.done and isinstance(run.error, RequestCancelled)
+    assert queued.done and isinstance(queued.error, RequestCancelled)
+    with pytest.raises(RequestCancelled):
+        run.result()
+    assert eng.allocator.num_in_use == 0
+    assert telemetry.counter("serve.cancelled").value == before + 2
+    # cancel() after completion is a no-op that reports so.
+    done = eng.submit(prompt_of(4), max_new_tokens=2, key=2)
+    eng.drain()
+    assert done.result() == solo(model, cfg, params, prompt_of(4), 2, 2)
+    assert not done.cancel()
+
+
+# ---------------------------------------------------------------------------
+# Overload shedding
+
+
+def test_shed_reject_new(family):
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, max_queue=2, **ENGINE_KW
+    )
+    before = telemetry.counter("serve.shed").value
+    handles = [
+        eng.submit(prompt_of(4, base=i + 1), max_new_tokens=4, key=i)
+        for i in range(2)
+    ]
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit(prompt_of(4), max_new_tokens=4, key=9)
+    assert ei.value.retryable
+    assert eng.health() is Health.OVERLOADED
+    assert telemetry.counter("serve.shed").value == before + 1
+    eng.drain()  # pressure drops → READY again, everyone completes
+    assert eng.health() is Health.READY
+    for i, h in enumerate(handles):
+        assert h.result() == solo(
+            model, cfg, params, prompt_of(4, base=i + 1), i, 4
+        )
+
+
+def test_shed_drop_oldest(family):
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, max_queue=2,
+        shed_policy="drop-oldest", **ENGINE_KW,
+    )
+    oldest = eng.submit(prompt_of(4, base=1), max_new_tokens=4, key=0)
+    second = eng.submit(prompt_of(4, base=2), max_new_tokens=4, key=1)
+    newest = eng.submit(prompt_of(4, base=3), max_new_tokens=4, key=2)
+    # The queue stayed bounded: the OLDEST was shed, the newest admitted.
+    assert oldest.done and isinstance(oldest.error, EngineOverloaded)
+    assert oldest.error.retryable
+    eng.drain()
+    assert second.result() == solo(
+        model, cfg, params, prompt_of(4, base=2), 1, 4
+    )
+    assert newest.result() == solo(
+        model, cfg, params, prompt_of(4, base=3), 2, 4
+    )
+    assert eng.allocator.num_in_use == 0
+
+
+def test_shed_policy_validation(family):
+    model, cfg, params = family
+    with pytest.raises(ValueError, match="shed_policy"):
+        Engine(params, model=model, cfg=cfg, shed_policy="lru", **ENGINE_KW)
+
+
+def test_overload_detector_estimates():
+    det = OverloadDetector(max_queue=4, max_ttft_s=1.0)
+    assert det.enabled
+    assert not det.overloaded(3, 1)
+    assert det.overloaded(4, 1)  # queue bound
+    det.observe_tick(0.5)
+    assert det.est_ttft_s(3, 1) == pytest.approx(2.0)
+    assert det.overloaded(3, 1)  # TTFT bound: 4 ticks * 0.5s > 1s
+    assert not det.overloaded(0, 1)  # 1 tick * 0.5s <= 1s
+    # EWMA converges downward as ticks speed up.
+    for _ in range(50):
+        det.observe_tick(0.01)
+    assert not det.overloaded(3, 1)
+    with pytest.raises(ValueError):
+        OverloadDetector(max_queue=0)
+    with pytest.raises(ValueError):
+        OverloadDetector(max_ttft_s=0.0)
+    assert not OverloadDetector().enabled
+
+
+# ---------------------------------------------------------------------------
+# Admission validation (livelock fix) + backpressure visibility
+
+
+def test_submit_rejects_never_admissible_immediately(family):
+    """A request that can NEVER fit must raise at submit() — parking it
+    at the FIFO head would make tokens() spin step() forever."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, num_slots=1, block_size=8,
+        num_blocks=3, max_model_len=32,
+    )
+    with pytest.raises(ValueError, match="max_model_len"):
+        eng.submit(np.zeros(30, np.int32), max_new_tokens=30)
+    with pytest.raises(ValueError, match="num_blocks"):
+        eng.submit(np.zeros(20, np.int32), max_new_tokens=10)
+    with pytest.raises(ValueError, match="num_slots"):
+        Engine(params, model=model, cfg=cfg, num_slots=0)
+    # An admissible request still flows normally afterwards.
+    h = eng.submit(prompt_of(4), max_new_tokens=2, key=0)
+    eng.drain()
+    assert len(h.result()) == 2
+    assert eng.allocator.num_in_use == 0
+
+
+def test_slot_bound_stall_counts_backpressure(family):
+    """With every slot busy and work waiting, the stall must be counted
+    — the old loop only counted page-bound stalls, so a slot-bound
+    engine looked healthily idle in telemetry."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, num_slots=1, block_size=8,
+        max_model_len=64, decode_chunk=4,
+    )
+    eng.submit(prompt_of(6), max_new_tokens=12, key=0)
+    eng.step()  # occupies the only slot
+    eng.submit(prompt_of(6), max_new_tokens=4, key=1)
+    before = telemetry.counter("serve.backpressure").value
+    eng.step()  # queue non-empty, zero free slots → visible stall
+    assert telemetry.counter("serve.backpressure").value > before
+    eng.drain()
+    assert eng.allocator.num_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# Fault sites: serve.prefill / serve.recover
+
+
+def test_fault_prefill_io_requeues_token_identical(family):
+    model, cfg, params = family
+    before = telemetry.counter("serve.prefill_retries").value
+    faults.reset("serve.prefill:1:io")
+    eng = Engine(params, model=model, cfg=cfg, eos_id=EOS, **ENGINE_KW)
+    h = eng.submit(prompt_of(6), max_new_tokens=8, key=0)
+    eng.drain()
+    assert h.result() == solo(model, cfg, params, prompt_of(6), 0, 8, eos=EOS)
+    assert telemetry.counter("serve.prefill_retries").value == before + 1
+    assert eng.allocator.num_in_use == 0
+
+
+def test_fault_recover_io_consumes_budget(monkeypatch, family):
+    """serve.recover:io fails one supervisor replay attempt: with a
+    budget of max_recoveries=2 the replay retries and completes
+    token-identically; the failed attempt is charged."""
+    import torchdistx_tpu.serving.engine as eng_mod
+
+    model, cfg, params = family
+    faults.reset("serve.recover:1:io")
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    h = eng.submit(prompt_of(6), max_new_tokens=12, key=0)
+    eng.step()
+    assert not h.done
+
+    real = eng_mod._decode_chunk
+    state = {"fail": True}
+
+    def die_once(params_, paged, *a, **k):
+        if state["fail"]:
+            state["fail"] = False
+            for leaf in jax.tree.leaves(paged):
+                leaf.delete()
+            raise RuntimeError("injected device failure")
+        return real(params_, paged, *a, **k)
+
+    monkeypatch.setattr(eng_mod, "_decode_chunk", die_once)
+    eng.drain()
+    assert h.result() == solo(model, cfg, params, prompt_of(6), 0, 12)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_prefill_failure_keeps_fifo_order(monkeypatch, family):
+    """A transiently-failing prefill must requeue its request at the
+    FIFO HEAD, ahead of the rest of its admission batch — not behind
+    it (the failure must not cost the request its place)."""
+    import torchdistx_tpu.serving.engine as eng_mod
+
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, num_slots=2, block_size=8,
+        max_model_len=64, decode_chunk=4, max_prefills_per_tick=2,
+    )
+    real = eng_mod._prefill
+    state = {"fail": True}
+
+    def boom_first(*a, **k):
+        if state["fail"]:
+            state["fail"] = False
+            raise RuntimeError("boom")
+        return real(*a, **k)
+
+    monkeypatch.setattr(eng_mod, "_prefill", boom_first)
+    ha = eng.submit(prompt_of(4, base=1), max_new_tokens=4, key=0)
+    hb = eng.submit(prompt_of(4, base=2), max_new_tokens=4, key=1)
+    eng.step()  # A's prefill fails: batch [A, B] requeued, A still head
+    assert [r.rid for r in eng.scheduler._waiting] == [ha.rid, hb.rid]
+    eng.drain()
+    assert ha.result() == solo(model, cfg, params, prompt_of(4, base=1), 0, 4)
+    assert hb.result() == solo(model, cfg, params, prompt_of(4, base=2), 1, 4)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_close_fails_outstanding_and_restores(family):
+    """close() retires an engine without a drain: outstanding work fails
+    with retryable typed errors, pages release, health lands STOPPED —
+    and it is idempotent."""
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    run = eng.submit(prompt_of(6), max_new_tokens=30, key=0)
+    eng.step()
+    assert not run.done
+    queued = eng.submit(prompt_of(5), max_new_tokens=4, key=1)
+    eng.close()
+    assert eng.health() is Health.STOPPED
+    assert isinstance(run.error, RequestPreempted) and run.error.retryable
+    assert isinstance(queued.error, EngineDraining) and queued.error.retryable
+    assert eng.allocator.num_in_use == 0
+    eng.close()  # idempotent
+    with pytest.raises(EngineDraining):
+        eng.submit(prompt_of(4), max_new_tokens=2, key=2)
+
+
+# ---------------------------------------------------------------------------
+# Seeded mini chaos soak (the CI-scale soak lives in scripts/chaos_soak.py)
+
+
+def test_chaos_mini_soak(monkeypatch, family):
+    """Randomized faults + lifecycle churn over mixed requests: every
+    request completes token-identical to solo generate() or fails with a
+    typed RequestError; no hangs, zero leaked pages, engine READY."""
+    import torchdistx_tpu.serving.engine as eng_mod
+
+    model, cfg, params = family
+    rng = np.random.default_rng(1234)
+    specs = []
+    for site, lo, hi in [
+        ("serve.admit", 2, 12), ("serve.prefill", 2, 12),
+        ("serve.step", 2, 20), ("serve.recover", 1, 3),
+    ]:
+        for step in rng.integers(lo, hi, size=2):
+            kind = rng.choice(["io", "nan"]) if site != "serve.recover" else "io"
+            specs.append(f"{site}:{int(step)}:{kind}")
+    faults.reset(",".join(sorted(set(specs))))
+
+    eng = Engine(
+        params, model=model, cfg=cfg, eos_id=EOS, num_slots=2,
+        block_size=8, num_blocks=17, max_model_len=64, decode_chunk=4,
+    )
+    real = eng_mod._decode_chunk
+    chaos = {"chunks": 0}
+
+    def flaky(params_, paged, *a, **k):
+        chaos["chunks"] += 1
+        if chaos["chunks"] in (5, 9):  # seeded device failures
+            for leaf in jax.tree.leaves(paged):
+                leaf.delete()
+            raise RuntimeError("chaos device failure")
+        return real(params_, paged, *a, **k)
+
+    monkeypatch.setattr(eng_mod, "_decode_chunk", flaky)
+
+    reqs = []
+    for i in range(24):
+        plen = int(rng.integers(3, 14))
+        mnt = int(rng.choice([4, 8, 12]))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        deadline = 30.0 if rng.random() > 0.1 else 1e-6
+        h = eng.submit(prompt, max_new_tokens=mnt, key=i, deadline_s=deadline)
+        if rng.random() < 0.1:
+            h.cancel()
+        reqs.append((prompt, mnt, i, h))
+
+    for _ in range(3000):  # bounded drive: a hang fails loudly
+        if not (len(eng.scheduler) or eng._n_running()):
+            break
+        eng.step()
+    else:
+        pytest.fail("chaos soak did not drain within the step bound")
+
+    n_ok = 0
+    for prompt, mnt, key, h in reqs:
+        assert h.done, f"request {key} neither finished nor failed"
+        if h.error is not None:
+            assert isinstance(h.error, RequestError), h.error
+        else:
+            assert h.result() == solo(
+                model, cfg, params, prompt, key, mnt, eos=EOS
+            ), f"request {key} diverged from solo generate"
+            n_ok += 1
+    assert n_ok >= 10, "chaos shed almost everything — soak too aggressive"
+    assert eng.allocator.num_in_use == 0, "pages leaked"
+    assert eng.health() is Health.READY

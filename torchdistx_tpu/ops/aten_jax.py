@@ -398,3 +398,12 @@ def _diag(ctx, x, *args, **kwargs):
 @lowering("aten.repeat.default")
 def _repeat(ctx, x, repeats, **kwargs):
     return _jnp().tile(x, tuple(repeats))
+
+
+@lowering("aten._unsafe_view.default")
+def _unsafe_view(ctx, x, size, **kwargs):
+    # reshape-of-non-contiguous lowers to clone + _unsafe_view; unlike
+    # aten.view it carries NO alias info (the clone is the only reader),
+    # so it reaches the lowerings as a functional op rather than the
+    # engine's layout-only view path.  Found by tests/test_tape_fuzz.py.
+    return _jnp().reshape(x, tuple(size))

@@ -24,6 +24,14 @@ of those a first-class, *observable* path:
   injection (``TDX_FAULT="site:step:kind"``) so tests and CI prove the
   crash/retry/skip paths without flaky process games.
 
+The same machinery extends into the serving stack
+(:mod:`torchdistx_tpu.serving.lifecycle`): the preemption flag drives
+the engine's graceful drain, the fault registry covers the
+``serve.admit``/``serve.prefill``/``serve.step``/``serve.recover``
+sites, and a crash-recovery supervisor replays in-flight requests
+token-identically after failed device calls — request-lifecycle
+robustness (deadlines, cancellation, overload shedding) rides on top.
+
 Like :mod:`~torchdistx_tpu.telemetry`, the package is dependency-free at
 module level (stdlib only; jax imports live inside the functions that
 need them), so it is importable in the torch-only environment.

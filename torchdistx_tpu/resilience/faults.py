@@ -20,9 +20,17 @@ Sites (where the stack asks):
 * ``serve.admit`` — in the serving engine's admission phase, before any
   request is popped or any page allocated (step = admission attempt;
   ``nan`` skips the admission tick).
+* ``serve.prefill`` — before the engine dispatches one request's
+  prefill (step = prefill attempt).  ``io``/``nan`` return the request
+  (and the rest of the admission batch) to the FIFO head; the next tick
+  retries in order.
 * ``serve.step``  — before the serving engine dispatches a decode chunk
   (step = decode-chunk number).  ``nan`` here means "this chunk is
   poisoned": the engine skips it cleanly and re-runs next tick.
+* ``serve.recover`` — before one replay attempt of the engine's
+  crash-recovery supervisor (step = replay attempt).  ``io``/``nan``
+  fail that replay, consuming the request's recovery budget — the path
+  that proves budgets exhaust into typed errors instead of hangs.
 
 Kinds (what happens):
 
@@ -69,7 +77,15 @@ __all__ = [
 ENV_VAR = "TDX_FAULT"
 CRASH_EXIT_CODE = 13
 SITES = frozenset(
-    {"ckpt.save", "data.next", "step.exec", "serve.admit", "serve.step"}
+    {
+        "ckpt.save",
+        "data.next",
+        "step.exec",
+        "serve.admit",
+        "serve.prefill",
+        "serve.step",
+        "serve.recover",
+    }
 )
 KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan"})
 

@@ -3,9 +3,15 @@
 Preemptible TPU VMs get SIGTERM with a grace window; Ctrl-C is the
 interactive equivalent.  A signal handler must not checkpoint (it can
 interrupt arbitrary code, including orbax mid-write) — it only sets a
-flag here, and the training loop (:func:`torchdistx_tpu.parallel.fit`)
-checks the flag at each step boundary, where state is consistent, saves
-a final checkpoint, flushes telemetry, and returns resumably.
+flag here, and the flag's consumers act at their own safe boundaries:
+the training loop (:func:`torchdistx_tpu.parallel.fit`) checks it at
+each step boundary, where state is consistent, saves a final
+checkpoint, flushes telemetry, and returns resumably; the serving
+engine (:class:`torchdistx_tpu.serving.Engine`) checks it at each tick
+and moves through its graceful-drain state machine — admission closed,
+in-flight requests finished within the drain deadline, the remainder
+failed with a retryable typed error.  Both clear the flag once acted
+on (a platform that is really going down keeps signalling).
 
 Semantics:
 

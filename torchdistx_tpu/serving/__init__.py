@@ -12,7 +12,12 @@ allocated per row up front.  This package replaces that for serving:
   (one compiled decode chunk over fixed slots, per-bucket compiled
   prefill, slot recycling at chunk boundaries);
 * :mod:`.scheduler` — FIFO admission, the prefill/decode interleave
-  knob, and the streaming :class:`~.scheduler.RequestHandle`.
+  knob, and the streaming :class:`~.scheduler.RequestHandle`;
+* :mod:`.lifecycle` — the request-lifecycle robustness layer: typed
+  errors (deadline, cancel, shed, preempt, recovery), the
+  :class:`~.lifecycle.Health` state machine
+  (STARTING→READY→DRAINING→STOPPED, plus OVERLOADED), and the
+  :class:`~.lifecycle.OverloadDetector` behind the shedding policy.
 
 Quick start::
 
@@ -21,28 +26,55 @@ Quick start::
 
     eng = Engine(params, model=llama, cfg=cfg, num_slots=8,
                  block_size=16, eos_id=2)
-    h = eng.submit(prompt_ids, max_new_tokens=128, key=0)
+    h = eng.submit(prompt_ids, max_new_tokens=128, key=0, deadline_s=30)
     for tok in h.tokens():      # streams; drives the engine
         print(tok)
 
 Engine output is token-identical to solo ``generate`` with the same key
-(see :mod:`.engine`).  Telemetry: ``serve.*`` spans/counters/gauges
-(docs/observability.md); fault sites ``serve.admit`` / ``serve.step``
+(see :mod:`.engine`) — and stays token-identical across device-call
+failures: a crash-recovery supervisor rebuilds the paged pool and
+replays live requests from their committed tokens.  SIGTERM (via
+:mod:`torchdistx_tpu.resilience.preemption`) drains the engine
+gracefully: admission stops, in-flight work finishes within the drain
+deadline, the remainder fails with a retryable typed error.  Telemetry:
+``serve.*`` spans/counters/gauges (docs/observability.md); fault sites
+``serve.admit`` / ``serve.prefill`` / ``serve.step`` / ``serve.recover``
 (docs/resilience.md).  Full design: docs/serving.md.
 """
 
 from .blocks import BlockAllocator, blocks_needed  # noqa: F401
-from .cache import init_paged_cache, write_prompt  # noqa: F401
+from .cache import fresh_pool, init_paged_cache, write_prompt  # noqa: F401
 from .engine import Engine  # noqa: F401
+from .lifecycle import (  # noqa: F401
+    DeadlineExceeded,
+    EngineDraining,
+    EngineOverloaded,
+    Health,
+    OverloadDetector,
+    RecoveryFailed,
+    RequestCancelled,
+    RequestError,
+    RequestPreempted,
+)
 from .scheduler import FIFOScheduler, Request, RequestHandle  # noqa: F401
 
 __all__ = [
     "BlockAllocator",
+    "DeadlineExceeded",
     "Engine",
+    "EngineDraining",
+    "EngineOverloaded",
     "FIFOScheduler",
+    "Health",
+    "OverloadDetector",
+    "RecoveryFailed",
     "Request",
+    "RequestCancelled",
+    "RequestError",
     "RequestHandle",
+    "RequestPreempted",
     "blocks_needed",
+    "fresh_pool",
     "init_paged_cache",
     "write_prompt",
 ]

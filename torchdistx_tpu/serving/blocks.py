@@ -88,6 +88,19 @@ class BlockAllocator:
         _G_UTIL.set(round(self.utilization(), 4))
         return out
 
+    def reset(self) -> None:
+        """Forget every grant and rebuild the full free list.
+
+        The crash-recovery supervisor's primitive: when a failed device
+        call consumes the donated page pool, every page's KV is gone and
+        the ownership map with it — the supervisor installs a fresh pool
+        and re-reserves pages per replayed request from a clean map.
+        Page order matches a fresh allocator, so a deterministic replay
+        produces deterministic tables."""
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._in_use = set()
+        _G_UTIL.set(0.0)
+
     def free(self, blocks: List[int]) -> None:
         """Return pages to the free list; freeing an unowned page raises."""
         for blk in blocks:

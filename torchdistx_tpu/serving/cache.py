@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from .blocks import TRASH_BLOCK
 
-__all__ = ["init_paged_cache", "write_prompt"]
+__all__ = ["fresh_pool", "init_paged_cache", "write_prompt"]
 
 
 def init_paged_cache(model, cfg, num_blocks: int, block_size: int):
@@ -44,6 +44,20 @@ def init_paged_cache(model, cfg, num_blocks: int, block_size: int):
         )
 
     return jax.tree.map(page, proto)
+
+
+def fresh_pool(paged):
+    """A zeroed pool with ``paged``'s shapes/dtypes — without re-probing
+    the model.
+
+    Built from shape/dtype metadata only, so it works even when
+    ``paged``'s buffers were consumed by a failed donated call
+    (``is_deleted()`` leaves still carry their aval).  This is the
+    recovery supervisor's rebuild primitive: the engine re-prefills
+    every live request into the fresh pool, so zeroed is the correct
+    initial state, exactly as at engine construction.
+    """
+    return jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), paged)
 
 
 @partial(jax.jit, static_argnames=("block_size",), donate_argnums=(0,))

@@ -28,14 +28,38 @@ and sampled.
 
 Sampling config (temperature/top_k/eos) is **engine-level static** — it
 is baked into the two compiled programs, exactly as it is baked into a
-``generate`` call.  Per-request knobs are prompt, budget, and key.
+``generate`` call.  Per-request knobs are prompt, budget, key, and
+``deadline_s``.
 
-Resilience: ``serve.admit`` and ``serve.step`` are ``TDX_FAULT`` sites.
-An ``io`` fault leaves state untouched and the tick retries; a ``nan``
-fault marks the decode chunk poisoned and the engine *skips* it (decode
-is a pure function of committed state, so the re-run next tick emits the
-identical tokens — the serving analog of the training loop's
-skip-step non-finite guard).  ``fatal`` propagates: fatal means fatal.
+Request lifecycle (see :mod:`.lifecycle` and ``docs/serving.md``):
+per-request **deadlines** and client **cancellation** act at chunk
+boundaries (pages released, handles raise typed errors); a bounded
+queue with a configurable **shedding policy** (``reject-new`` |
+``drop-oldest``) driven by an :class:`.lifecycle.OverloadDetector`
+guards admission; and SIGTERM (via
+:mod:`torchdistx_tpu.resilience.preemption`) moves the engine through
+the :class:`.lifecycle.Health` state machine — admission stops,
+in-flight work finishes under ``drain_deadline_s``, the remainder fails
+with a *retryable* typed error, never a silent truncation.
+
+Crash recovery: the **supervisor** wraps prefill/decode dispatch.  The
+compiled calls hold the page pool DONATED, so a failed device call may
+consume every live request's KV — instead of failing them loudly, the
+supervisor rebuilds the pool (:func:`.cache.fresh_pool`), resets the
+allocator, and *replays* each live request by re-prefilling
+``prompt + tokens-generated-so-far``.  Because sampling keys are
+``fold_in(key, n_gen)``, the continuation is token-identical — greedy
+and sampled — under a per-request ``max_recoveries`` budget before a
+typed :class:`.lifecycle.RecoveryFailed`.
+
+Fault sites (``TDX_FAULT``): ``serve.admit`` and ``serve.prefill`` —
+``io``/``nan`` requeue at the FIFO head and the next tick retries;
+``serve.step`` — ``io`` leaves state untouched (tick retries), ``nan``
+marks the chunk poisoned and the engine skips it pre-dispatch (decode is
+a pure function of committed state, so the re-run is token-identical —
+the serving analog of the training loop's skip-step non-finite guard);
+``serve.recover`` — fails one supervisor replay attempt, consuming
+recovery budget.  ``fatal`` propagates everywhere: fatal means fatal.
 """
 
 from __future__ import annotations
@@ -52,8 +76,19 @@ import numpy as np
 from .. import telemetry as _telemetry
 from ..models.generate import _sample
 from ..resilience import faults
+from ..resilience import preemption as _preemption
 from .blocks import BlockAllocator, blocks_needed
-from .cache import init_paged_cache, write_prompt
+from .cache import fresh_pool, init_paged_cache, write_prompt
+from .lifecycle import (
+    DeadlineExceeded,
+    EngineDraining,
+    EngineOverloaded,
+    Health,
+    OverloadDetector,
+    RecoveryFailed,
+    RequestCancelled,
+    RequestPreempted,
+)
 from .scheduler import FIFOScheduler, Request, RequestHandle
 
 __all__ = ["Engine"]
@@ -62,11 +97,20 @@ _T_REQUESTS = _telemetry.counter("serve.requests")
 _T_FINISHED = _telemetry.counter("serve.finished")
 _T_TOKENS = _telemetry.counter("serve.tokens_out")
 _T_ADMIT_RETRIES = _telemetry.counter("serve.admit_retries")
+_T_PREFILL_RETRIES = _telemetry.counter("serve.prefill_retries")
 _T_STEP_RETRIES = _telemetry.counter("serve.step_retries")
 _T_SKIPPED = _telemetry.counter("serve.skipped_steps")
+_T_SHED = _telemetry.counter("serve.shed")
+_T_EXPIRED = _telemetry.counter("serve.expired")
+_T_CANCELLED = _telemetry.counter("serve.cancelled")
+_T_RECOVERIES = _telemetry.counter("serve.recoveries")
+_T_RECOVERY_FAILURES = _telemetry.counter("serve.recovery_failures")
+_T_PREEMPTED = _telemetry.counter("serve.preempted")
 _G_RUNNING = _telemetry.gauge("serve.running_slots")
 _G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
 _G_TTFT = _telemetry.gauge("serve.ttft_s")
+_G_EST_TTFT = _telemetry.gauge("serve.est_ttft_s")
+_G_HEALTH = _telemetry.gauge("serve.health")
 
 
 @partial(
@@ -82,7 +126,9 @@ def _prefill(
 ):
     """Compiled prefill: contiguous forward over the padded prompt,
     first-token sample (``fold_in(key, 0)`` — ``generate``'s schedule),
-    and the page scatter.  One compile per prompt bucket."""
+    and the page scatter.  One compile per prompt bucket.  Recovery
+    replays reuse this same program over ``prompt + generated-so-far``
+    and discard the sampled token."""
     p_pad = prompt.shape[1]
     scratch = model.init_cache(cfg, 1, p_pad)
     logits, scratch = model.forward_cached(params, prompt, cfg, scratch, 0)
@@ -155,8 +201,29 @@ class Engine:
     decode_chunk : decode steps fused per host sync.  Recycling happens at
         chunk boundaries, so large chunks trade slot-turnaround (and thus
         a little throughput under churn) for far fewer host round-trips.
+        Deadlines/cancellations are also observed at chunk boundaries.
     max_prefills_per_tick : the prefill/decode interleave knob
         (see :class:`.scheduler.FIFOScheduler`).
+    max_queue / max_ttft_s : the overload detector's bounds (both None →
+        never overloaded; see :class:`.lifecycle.OverloadDetector`).
+    shed_policy : ``"reject-new"`` (overloaded ``submit`` raises
+        :class:`.lifecycle.EngineOverloaded`) or ``"drop-oldest"`` (the
+        oldest *waiting* request is failed with it instead and the new
+        one is admitted).
+    max_recoveries : per-request replay budget of the crash-recovery
+        supervisor before a typed :class:`.lifecycle.RecoveryFailed`.
+    drain_deadline_s : wall-clock budget for in-flight work once a drain
+        begins; the remainder fails with
+        :class:`.lifecycle.RequestPreempted` (retryable).
+    handle_preemption : install the SIGTERM/SIGINT flag handlers
+        (:mod:`torchdistx_tpu.resilience.preemption`) so a preemption
+        signal drains the engine; programmatic notice goes through
+        ``preemption.request()`` either way.  The flag is process-global
+        and cleared once acted on (the same convention ``fit()`` uses):
+        run ONE preemption consumer per process — an engine and a
+        training loop (or two engines) sharing a process would race for
+        the notice.  Retire an engine without a drain via
+        :meth:`close`, which restores the handlers it installed.
     """
 
     def __init__(
@@ -175,9 +242,19 @@ class Engine:
         decode_chunk: int = 8,
         max_prefills_per_tick: int = 1,
         min_prefill_bucket: int = 16,
+        max_queue: Optional[int] = None,
+        max_ttft_s: Optional[float] = None,
+        shed_policy: str = "reject-new",
+        max_recoveries: int = 2,
+        drain_deadline_s: float = 30.0,
+        handle_preemption: bool = True,
     ):
         self.model = model
         self.cfg = cfg
+        if num_slots < 1:
+            # Zero slots would park every request at the FIFO head with
+            # no slot ever freeing — tokens() would spin step() forever.
+            raise ValueError("num_slots must be >= 1")
         self.num_slots = num_slots
         self.block_size = block_size
         self.max_model_len = int(max_model_len or cfg.max_seq_len)
@@ -197,12 +274,24 @@ class Engine:
             # _bucket doubles up from this value; <= 0 would never
             # terminate.
             raise ValueError("min_prefill_bucket must be >= 1")
+        if shed_policy not in ("reject-new", "drop-oldest"):
+            raise ValueError(
+                f"shed_policy {shed_policy!r}: expected 'reject-new' or "
+                "'drop-oldest'"
+            )
+        self.shed_policy = shed_policy
+        self.max_recoveries = int(max_recoveries)
+        if self.max_recoveries < 0:
+            raise ValueError("max_recoveries must be >= 0")
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.max_prefills_per_tick = max_prefills_per_tick
 
         self._table_width = blocks_needed(self.max_model_len, block_size)
         if num_blocks is None:
             num_blocks = 1 + num_slots * self._table_width
         self.allocator = BlockAllocator(num_blocks, block_size)
         self.scheduler = FIFOScheduler(max_prefills_per_tick)
+        self.detector = OverloadDetector(max_queue, max_ttft_s)
 
         prep = getattr(model, "prep_decode", None)
         self._params = prep(params, cfg) if prep is not None else params
@@ -220,12 +309,29 @@ class Engine:
 
         self._next_rid = 0
         self._admit_no = 0  # admission attempts (serve.admit fault site)
+        self._prefill_no = 0  # prefill dispatches (serve.prefill site)
         self._decode_no = 0  # decode chunks attempted (serve.step site)
+        self._recover_no = 0  # supervisor replay attempts (serve.recover)
         self._decode_s = 0.0
         self._decode_tokens = 0
+        self._consec_decode_failures = 0
+        self._n_shed = 0
+        self._n_expired = 0
+        self._n_cancelled = 0
+        self._n_recoveries = 0
+        self._n_preempted = 0
         # Bounded: stats() reports percentiles over the most recent
         # window, and a long-lived engine must not grow per-request state.
         self._ttft = deque(maxlen=4096)
+
+        self._drain_t0: Optional[float] = None
+        self._drain_sp = None
+        self._handle_preemption = handle_preemption
+        self._handlers_preexisting = _preemption.installed()
+        if handle_preemption:
+            _preemption.install()
+        self._health = Health.STARTING
+        _G_HEALTH.set(self._health.value)
 
     # ------------------------------------------------------------------
     # Submission / draining
@@ -236,12 +342,26 @@ class Engine:
         *,
         max_new_tokens: int,
         key: Any = None,
+        deadline_s: Optional[float] = None,
     ) -> RequestHandle:
         """Queue a request; returns its streaming handle.
 
         ``key``: an int seed or a PRNG key array — the SAME key a solo
         ``generate(params, prompt[None], key, ...)`` call would take, for
         token parity.  Default: a key derived from the request id.
+
+        ``deadline_s``: wall-clock budget from submission.  A request
+        that has not finished when it expires fails with
+        :class:`.lifecycle.DeadlineExceeded` at the next chunk boundary
+        and releases its pages there.
+
+        Admissibility is validated HERE, immediately: a request that
+        could never run — oversized for ``max_model_len``, needing more
+        pages than the engine owns — raises ``ValueError`` now rather
+        than parking forever at the FIFO head (where ``tokens()`` would
+        spin the engine without progress).  Raises the retryable
+        :class:`.lifecycle.EngineDraining` when the engine is draining
+        or stopped, and sheds per ``shed_policy`` when overloaded.
         """
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
@@ -254,23 +374,67 @@ class Engine:
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens})"
                 f" = {total} exceeds max_model_len ({self.max_model_len})"
             )
+        if len(prompt) > self._bucket(len(prompt)):
+            # Unreachable while _bucket caps at max_model_len >= total,
+            # but pinned: a prompt wider than the widest prefill bucket
+            # would admit and then crash (or worse, truncate) at prefill.
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds the widest prefill "
+                f"bucket ({self._bucket(len(prompt))})"
+            )
         if blocks_needed(total, self.block_size) > self.allocator.capacity:
             raise ValueError(
                 "request needs more pages than the engine owns "
                 f"({blocks_needed(total, self.block_size)} > "
                 f"{self.allocator.capacity}); raise num_blocks"
             )
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (or None)")
+        # Normalize the key BEFORE any shedding side effect: a malformed
+        # key must raise without having killed a drop-oldest victim.
         if key is None:
             key = self._next_rid
         if isinstance(key, int):
             key = jax.random.PRNGKey(key)
         key = np.asarray(key).astype(np.uint32).reshape(2)
+        if self._health in (Health.DRAINING, Health.STOPPED):
+            raise EngineDraining(
+                f"engine is {self._health.value}; submit to another replica"
+            )
+        if self.detector.overloaded(
+            len(self.scheduler), self.max_prefills_per_tick
+        ):
+            self._set_health(Health.OVERLOADED)
+            if self.shed_policy == "reject-new":
+                _T_SHED.add()
+                self._n_shed += 1
+                raise EngineOverloaded(
+                    "engine overloaded "
+                    f"(queue={len(self.scheduler)}, est_ttft="
+                    f"{self.detector.est_ttft_s(len(self.scheduler), self.max_prefills_per_tick):.3f}s);"
+                    " retry with backoff"
+                )
+            victim = self.scheduler.shed_oldest()
+            if victim is not None:
+                _T_SHED.add()
+                self._n_shed += 1
+                victim.handle._fail(
+                    EngineOverloaded(
+                        f"request {victim.rid} shed under load (drop-oldest)"
+                    )
+                )
 
         rid = self._next_rid
         self._next_rid += 1
         handle = RequestHandle(self, rid)
+        deadline = (
+            time.perf_counter() + deadline_s if deadline_s is not None else None
+        )
         self.scheduler.push(
-            Request(rid, prompt, int(max_new_tokens), key, handle)
+            Request(
+                rid, prompt, int(max_new_tokens), key, handle,
+                deadline=deadline,
+            )
         )
         _T_REQUESTS.add()
         return handle
@@ -280,6 +444,15 @@ class Engine:
         while len(self.scheduler) or self._n_running():
             self.step()
 
+    def health(self) -> Health:
+        """Current :class:`.lifecycle.Health` state."""
+        return self._health
+
+    def _set_health(self, health: Health) -> None:
+        if health is not self._health:
+            self._health = health
+            _G_HEALTH.set(health.value)
+
     def _n_running(self) -> int:
         return sum(r is not None for r in self._slot_req)
 
@@ -287,17 +460,196 @@ class Engine:
     # The engine tick
 
     def step(self) -> None:
-        """One tick: admit + prefill (up to the interleave knob), then one
-        decode chunk over the running slots."""
-        self._admit_phase()
+        """One tick: act on preemption, reap expired/cancelled requests,
+        admit + prefill (up to the interleave knob), then one decode
+        chunk over the running slots."""
+        if self._health is Health.STOPPED:
+            # Raising (rather than a silent no-op) keeps a stray
+            # handle.tokens() loop from spinning a dead engine forever.
+            raise EngineDraining("engine is stopped")
+        t0 = time.perf_counter()
+        if self._health is not Health.DRAINING and _preemption.requested():
+            self._begin_drain()
+        self._reap_phase()
+        if self._health is not Health.DRAINING:
+            self._admit_phase()
         self._decode_phase()
+        if self._health is Health.DRAINING:
+            self._drain_tick()
+        elif self._health is Health.STARTING:
+            self._set_health(Health.READY)
+        elif self._health is Health.OVERLOADED and not self.detector.overloaded(
+            len(self.scheduler), self.max_prefills_per_tick
+        ):
+            self._set_health(Health.READY)
+        self.detector.observe_tick(time.perf_counter() - t0)
+        if self.detector.enabled:
+            _G_EST_TTFT.set(
+                round(
+                    self.detector.est_ttft_s(
+                        len(self.scheduler), self.max_prefills_per_tick
+                    ),
+                    4,
+                )
+            )
         _G_RUNNING.set(self._n_running())
 
+    # ------------------------------------------------------------------
+    # Lifecycle: reap, drain
+
+    def _reap_phase(self) -> None:
+        """Chunk-boundary lifecycle sweep: deadline expiries and client
+        cancellations, waiting and running both.  Pages release here —
+        'the next chunk boundary' of the documented contract."""
+        now = time.perf_counter()
+        expired, cancelled = self.scheduler.purge(now)
+        for req in expired:
+            self._n_expired += 1
+            _T_EXPIRED.add()
+            req.handle._fail(
+                DeadlineExceeded(
+                    f"request {req.rid} expired in queue before prefill"
+                )
+            )
+        for req in cancelled:
+            self._n_cancelled += 1
+            _T_CANCELLED.add()
+            req.handle._fail(
+                RequestCancelled(f"request {req.rid} cancelled while queued")
+            )
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if req.handle._cancel_requested:
+                self._n_cancelled += 1
+                _T_CANCELLED.add()
+                self._fail_running_slot(
+                    slot, RequestCancelled(f"request {req.rid} cancelled")
+                )
+            elif req.expired(now):
+                self._n_expired += 1
+                _T_EXPIRED.add()
+                self._fail_running_slot(
+                    slot,
+                    DeadlineExceeded(
+                        f"request {req.rid} exceeded its deadline after "
+                        f"{self._emitted[slot]} tokens"
+                    ),
+                )
+
+    def _fail_running_slot(self, slot: int, error) -> None:
+        """Abort a running slot: pages back, handle failed typed, slot
+        cleared.  The ONE place the release-on-failure choreography
+        lives (reap, drain deadline, and close all route here)."""
+        req = self._slot_req[slot]
+        self.allocator.free(req.blocks)
+        req.blocks = None
+        req.handle._fail(error)
+        self._clear_slot(slot)
+
+    def _begin_drain(self) -> None:
+        """Preemption observed: close admission, flush the queue with a
+        retryable error, and give in-flight work ``drain_deadline_s``."""
+        self._set_health(Health.DRAINING)
+        self._drain_t0 = time.perf_counter()
+        self._drain_sp = _telemetry.start_span(
+            "serve.drain",
+            n_running=self._n_running(),
+            n_waiting=len(self.scheduler),
+        )
+        # The flag is acted on (the convention fit() set): a later
+        # engine/run in this process starts clean; a platform that is
+        # really going down keeps signalling.
+        _preemption.clear()
+        for req in self.scheduler.flush():
+            self._n_preempted += 1
+            _T_PREEMPTED.add()
+            req.handle._fail(
+                RequestPreempted(
+                    f"request {req.rid} flushed before prefill: engine "
+                    "draining; retry against another replica"
+                )
+            )
+
+    def _drain_tick(self) -> None:
+        if self._n_running() == 0:
+            self._finish_drain(timed_out=False)
+            return
+        if time.perf_counter() - self._drain_t0 > self.drain_deadline_s:
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                self._n_preempted += 1
+                _T_PREEMPTED.add()
+                self._fail_running_slot(
+                    slot,
+                    RequestPreempted(
+                        f"request {req.rid} preempted mid-stream: drain "
+                        f"deadline ({self.drain_deadline_s}s) expired after "
+                        f"{self._emitted[slot]} tokens; retry against "
+                        "another replica"
+                    ),
+                )
+            self._finish_drain(timed_out=True)
+
+    def _finish_drain(self, *, timed_out: bool) -> None:
+        if self._drain_sp is not None:
+            self._drain_sp.end(timed_out=timed_out)
+            self._drain_sp = None
+        self._set_health(Health.STOPPED)
+        if self._handle_preemption and not self._handlers_preexisting:
+            _preemption.uninstall()
+
+    def close(self) -> None:
+        """Stop the engine NOW: fail queued and in-flight work with
+        retryable typed errors, release every page, and restore the
+        signal handlers this engine installed.  Idempotent.
+
+        The graceful path is a drain (SIGTERM / ``preemption.request()``
+        + stepping); ``close()`` is for retiring an engine without one —
+        otherwise the handlers it installed at construction would
+        outlive it and swallow the process's next Ctrl-C."""
+        if self._health is Health.STOPPED:
+            return
+        for req in self.scheduler.flush():
+            self._n_preempted += 1
+            _T_PREEMPTED.add()
+            req.handle._fail(
+                EngineDraining(
+                    f"request {req.rid} rejected: engine closed before "
+                    "prefill; retry against another replica"
+                )
+            )
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self._n_preempted += 1
+            _T_PREEMPTED.add()
+            self._fail_running_slot(
+                slot,
+                RequestPreempted(
+                    f"request {req.rid} aborted after "
+                    f"{self._emitted[slot]} tokens: engine closed; retry "
+                    "against another replica"
+                ),
+            )
+        self._finish_drain(timed_out=False)
+
+    # ------------------------------------------------------------------
+    # Admission
+
     def _admit_phase(self) -> None:
+        if not len(self.scheduler):
+            return
         free_slots = [
             i for i, r in enumerate(self._slot_req) if r is None
         ]
-        if not free_slots or not len(self.scheduler):
+        if not free_slots:
+            # Slot-bound stall with work waiting: the scheduler owns the
+            # backpressure rule, so route through it (its limit==0 path
+            # counts the stall exactly like a page-bound one — an
+            # invisible stall reads as a healthy idle engine).
+            self.scheduler.pop_admissible(0, self.allocator, self.block_size)
             return
         self._admit_no += 1
         try:
@@ -316,46 +668,99 @@ class Engine:
         batch = self.scheduler.pop_admissible(
             len(free_slots), self.allocator, self.block_size
         )
-        for req in batch:
+        for i, req in enumerate(batch):
+            self._prefill_no += 1
+            try:
+                kind = faults.fire("serve.prefill", self._prefill_no)
+            except OSError:
+                # Transient prefill failure before dispatch: the request
+                # (and the rest of the batch) returns to the FIFO head.
+                _T_PREFILL_RETRIES.add()
+                self.scheduler.requeue([req] + batch[i + 1:])
+                return
+            except BaseException:
+                # Fatal kinds propagate, but the popped request must not
+                # vanish from every queue on the way out — a handle in
+                # neither the FIFO nor a slot spins tokens() forever.
+                self.scheduler.requeue([req] + batch[i + 1:])
+                raise
+            if kind is not None:  # nan: poisoned prefill tick — skip it
+                _T_PREFILL_RETRIES.add()
+                self.scheduler.requeue([req] + batch[i + 1:])
+                return
             slot = free_slots.pop(0)
-            self._prefill_into(slot, req)
+            try:
+                self._prefill_into(slot, req)
+            except (KeyboardInterrupt, SystemExit):
+                self.scheduler.requeue([req] + batch[i + 1:])
+                raise
+            except faults.FatalInjectedFault:
+                self.scheduler.requeue([req] + batch[i + 1:])
+                raise
+            except Exception as err:
+                # Supervised prefill: the reservation was already
+                # released (see _prefill_into); if the donated pool was
+                # consumed, rebuild it and replay the live slots, then
+                # charge THIS request's budget and retry it from the
+                # queue — or fail it typed once the budget is gone.
+                if self._pool_lost():
+                    self._supervise_recovery(err)
+                req.recoveries += 1
+                if req.recoveries > self.max_recoveries:
+                    _T_RECOVERY_FAILURES.add()
+                    req.handle._fail(
+                        RecoveryFailed(
+                            f"request {req.rid} aborted: prefill failed "
+                            f"{req.recoveries} times ({err!r})"
+                        )
+                    )
+                    self.scheduler.requeue(batch[i + 1:])
+                else:
+                    _T_PREFILL_RETRIES.add()
+                    # ONE requeue call: the failed request must land at
+                    # the head, AHEAD of its batch-mates (two calls
+                    # would appendleft the tail in front of it).
+                    self.scheduler.requeue([req] + batch[i + 1:])
+                return
 
-    def _prefill_into(self, slot: int, req: Request) -> None:
-        s = len(req.prompt)
+    def _prefill_dispatch(self, req: Request, seq: np.ndarray):
+        """The ONE prefill choreography (admission and recovery replay
+        both route here): reserve the request's full page quota, pad
+        ``seq`` to its bucket, run the compiled prefill (pool donated),
+        and free the reservation before any error surfaces — a leaked
+        reservation drives the engine into permanent backpressure.
+        Returns ``(sampled_token, table)``."""
+        length = len(seq)
         blocks = self.allocator.alloc(
             blocks_needed(req.cache_tokens, self.block_size)
         )
-        if blocks is None:  # pop_admissible reserved cumulatively
-            raise RuntimeError("scheduler admitted past the free list")
+        if blocks is None:  # admission reserved cumulatively / allocator reset
+            raise RuntimeError("prefill could not reserve its promised pages")
         req.blocks = blocks
-        bucket = self._bucket(s)
+        bucket = self._bucket(length)
         padded = np.zeros((1, bucket), np.int32)
-        padded[0, :s] = req.prompt
+        padded[0, :length] = seq
         table = np.zeros((self._table_width,), np.int32)
         table[: len(blocks)] = blocks
         try:
-            with _telemetry.span(
-                "serve.prefill", slot=slot, prompt_len=s, bucket=bucket
-            ):
-                first, self._cache = _prefill(
-                    self._params, self._cache, padded, s, req.key, table,
-                    model=self.model, cfg=self.cfg,
-                    temperature=self.temperature, top_k=self.top_k,
-                    block_size=self.block_size,
-                )
-                first = int(first)
+            first, self._cache = _prefill(
+                self._params, self._cache, padded, length, req.key, table,
+                model=self.model, cfg=self.cfg,
+                temperature=self.temperature, top_k=self.top_k,
+                block_size=self.block_size,
+            )
         except BaseException:
-            # A failed prefill (compile error, device OOM) must not leak
-            # the reservation — pages go back before the error surfaces,
-            # or a few such failures drive the engine into permanent
-            # backpressure.  And because the call held the DONATED cache,
-            # a failure during execution may have consumed the pool:
-            # recover it (failing any in-flight requests whose KV died
-            # with it) so the engine stays servable.
             self.allocator.free(blocks)
             req.blocks = None
-            self._recover_lost_cache()
             raise
+        return int(first), table
+
+    def _prefill_into(self, slot: int, req: Request) -> None:
+        s = len(req.prompt)
+        with _telemetry.span(
+            "serve.prefill", slot=slot, prompt_len=s, bucket=self._bucket(s)
+        ):
+            first, table = self._prefill_dispatch(req, req.prompt)
         req.handle.ttft_s = time.perf_counter() - req.submit_t
         self._ttft.append(req.handle.ttft_s)
         _G_TTFT.set(round(req.handle.ttft_s, 4))
@@ -379,6 +784,9 @@ class Engine:
         while b < prompt_len:
             b *= 2
         return min(b, self.max_model_len)
+
+    # ------------------------------------------------------------------
+    # Decode + the recovery supervisor
 
     def _decode_phase(self) -> None:
         if not self._n_running():
@@ -412,12 +820,31 @@ class Engine:
                 temperature=self.temperature, top_k=self.top_k,
                 eos_id=self.eos_id, n_steps=self.decode_chunk,
             )
-        except BaseException:
-            # The chunk held the donated cache; see _recover_lost_cache.
+        except (KeyboardInterrupt, SystemExit):
             sp.cancel()
-            self._recover_lost_cache()
             raise
+        except faults.FatalInjectedFault:
+            sp.cancel()
+            raise
+        except Exception as err:
+            sp.cancel()
+            self._consec_decode_failures += 1
+            if not self._pool_lost() and self._consec_decode_failures <= 1:
+                # The donation was not consumed and nothing committed:
+                # decode is pure over committed state, so the next
+                # tick's re-run is free and token-identical.  One free
+                # retry — a deterministic error must not spin, so the
+                # second consecutive failure escalates below.
+                _T_STEP_RETRIES.add()
+                return
+            # The chunk held the donated cache (or keeps failing): the
+            # supervisor rebuilds the pool and replays every live
+            # request token-identically, under per-request budgets.
+            self._consec_decode_failures = 0
+            self._supervise_recovery(err)
+            return
         out = np.asarray(out)  # (chunk, S) — the one host sync per chunk
+        self._consec_decode_failures = 0
         dt = time.perf_counter() - t0
         self._decode_s += dt
 
@@ -441,6 +868,126 @@ class Engine:
         if self._decode_s > 0:
             _G_DECODE_TPS.set(round(self._decode_tokens / self._decode_s, 1))
         sp.end(tokens=committed)
+
+    def _pool_lost(self) -> bool:
+        """True when a failed donated call consumed the page pool."""
+        return any(
+            isinstance(x, jax.Array) and x.is_deleted()
+            for x in jax.tree.leaves(self._cache)
+        )
+
+    def _supervise_recovery(self, error: BaseException) -> None:
+        """Restore servability after a failed device call, replaying the
+        live requests instead of failing them.
+
+        The pool (and with it every live request's KV) is assumed gone:
+        a fresh zeroed pool is installed, the allocator map reset, and
+        each live request re-prefilled over ``prompt + generated-so-far``
+        — ``fold_in(key, n_gen)`` sampling makes the continuation
+        token-identical, greedy and sampled.  Each recovery event (and
+        each failed replay) charges the request's ``max_recoveries``
+        budget; exhaustion is a typed, *retryable*
+        :class:`.lifecycle.RecoveryFailed` — never a silently truncated
+        stream.  A failed replay may itself have consumed the fresh pool,
+        so the whole pass restarts (budgets keep it finite).
+        """
+        self._n_recoveries += 1
+        _T_RECOVERIES.add()
+        sp = _telemetry.start_span(
+            "serve.recover",
+            n_live=self._n_running(),
+            error=type(error).__name__,
+        )
+        pending = [
+            (slot, req)
+            for slot, req in enumerate(self._slot_req)
+            if req is not None
+        ]
+        for _, req in pending:
+            req.recoveries += 1
+        while True:
+            replayed = 0  # an aborted pass's replays died with its pool
+            self.allocator.reset()
+            self._cache = fresh_pool(self._cache)
+            still = []
+            for slot, req in pending:
+                if req.recoveries > self.max_recoveries:
+                    req.blocks = None
+                    _T_RECOVERY_FAILURES.add()
+                    req.handle._fail(
+                        RecoveryFailed(
+                            f"request {req.rid} aborted: recovery budget "
+                            f"({self.max_recoveries}) exhausted after "
+                            f"{self._emitted[slot]} tokens ({error!r})"
+                        )
+                    )
+                    self._clear_slot(slot)
+                else:
+                    still.append((slot, req))
+            pending = still
+            if not pending:
+                break
+            failed = False
+            for slot, req in pending:
+                self._recover_no += 1
+                try:
+                    kind = faults.fire("serve.recover", self._recover_no)
+                    if kind is not None:
+                        # Cooperation kinds (nan) poison THIS replay
+                        # attempt — a consumed spec that silently did
+                        # nothing would defeat the registry's point.
+                        raise faults.InjectedFault(
+                            f"poisoned replay attempt ({kind})"
+                        )
+                    self._replay_into(slot, req)
+                    replayed += 1
+                except (KeyboardInterrupt, SystemExit):
+                    sp.cancel()
+                    raise
+                except faults.FatalInjectedFault:
+                    sp.cancel()
+                    raise
+                except Exception:
+                    # This replay's donated call may have consumed the
+                    # fresh pool too: charge the failing request and
+                    # restart the whole pass from a clean map.
+                    req.recoveries += 1
+                    failed = True
+                    break
+            if not failed:
+                break
+        sp.end(n_replayed=replayed)
+
+    def _replay_into(self, slot: int, req: Request) -> None:
+        """Re-prefill a live request's ``prompt + generated-so-far`` into
+        fresh pages, restoring the slot exactly where it was.
+
+        The committed tokens live on the handle; all but the last were
+        already *fed* to the model (the last is the slot's pending input
+        token), so the replayed sequence is ``prompt + tokens[:-1]`` and
+        the reused prefill program's sampled token — a recomputation of
+        an already-committed one — is discarded.  The next decode step
+        samples with ``fold_in(key, n_gen)``, the exact key the
+        uninterrupted run would have used."""
+        toks = req.handle._tokens
+        n_gen = len(toks)
+        seq = np.concatenate(
+            [req.prompt, np.asarray(toks[:-1], np.int32)]
+        ).astype(np.int32)
+        # Same dispatch as admission; the sampled token is a
+        # recomputation of an already-committed one and is discarded.
+        _, table = self._prefill_dispatch(req, seq)
+        self._slot_req[slot] = req
+        self._tokens[slot] = toks[-1]
+        self._positions[slot] = len(seq)
+        self._n_gen[slot] = n_gen
+        self._done[slot] = False
+        self._keys[slot] = req.key
+        self._tables[slot] = table
+        self._emitted[slot] = n_gen
+
+    # ------------------------------------------------------------------
+    # Token commit / retirement
 
     def _push_token(self, slot: int, token: int) -> None:
         """Commit one token to the slot's handle; retire on EOS/budget."""
@@ -469,48 +1016,25 @@ class Engine:
         self._done[slot] = True
         self._tables[slot] = 0  # idle slots scribble on the trash page
 
-    def _recover_lost_cache(self) -> None:
-        """Restore servability after a compiled call that held the
-        DONATED page pool raised.
-
-        If the failure happened before execution (trace/compile error),
-        the donation was never consumed and this is a no-op.  If the
-        buffers are gone, every running request's KV died with them:
-        those requests are failed loudly (their handles raise — a silent
-        truncated stream would look like a short completion), their
-        pages freed, and a fresh zeroed pool installed so NEW requests
-        keep being served.
-        """
-        if not any(
-            isinstance(x, jax.Array) and x.is_deleted()
-            for x in jax.tree.leaves(self._cache)
-        ):
-            return
-        for slot, req in enumerate(self._slot_req):
-            if req is None:
-                continue
-            self.allocator.free(req.blocks)
-            req.blocks = None
-            req.handle._fail(
-                "KV page pool lost to a failed device call"
-            )
-            self._clear_slot(slot)
-        self._cache = init_paged_cache(
-            self.model, self.cfg, self.allocator.num_blocks, self.block_size
-        )
-
     # ------------------------------------------------------------------
     # Introspection
 
     def stats(self) -> dict:
-        """Host-side serving stats (TTFT percentiles, sustained decode)."""
+        """Host-side serving stats (TTFT percentiles, sustained decode,
+        lifecycle counts)."""
         out = {
+            "health": self._health.value,
             "requests": self._next_rid,
             "running": self._n_running(),
             "waiting": len(self.scheduler),
             "decode_tokens": self._decode_tokens,
             "decode_s": round(self._decode_s, 4),
             "block_utilization": round(self.allocator.utilization(), 4),
+            "shed": self._n_shed,
+            "expired": self._n_expired,
+            "cancelled": self._n_cancelled,
+            "recoveries": self._n_recoveries,
+            "preempted": self._n_preempted,
         }
         if self._decode_s > 0:
             out["decode_tokens_per_s"] = round(

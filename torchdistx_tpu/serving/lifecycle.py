@@ -1,0 +1,170 @@
+"""Request-lifecycle robustness: typed errors, engine health, overload.
+
+The serving engine's failure surface, made first-class (the serving
+analog of what :mod:`torchdistx_tpu.resilience` did for training).
+Production continuous-batching systems (vLLM, Orca) treat admission
+control and failure recovery as part of the scheduler contract, not as
+exception noise — a caller must be able to tell, from the *type* of a
+failure, whether to retry the request elsewhere (`retryable=True`:
+overload shed, drain preemption), fix the request (validation errors
+raise plain ``ValueError`` at ``submit``), or give up (deadline,
+cancellation, exhausted recovery budget).
+
+Three pieces live here:
+
+* the **typed error taxonomy** — every way a submitted request can fail
+  is a :class:`RequestError` subclass carrying ``retryable``; handles
+  raise these from ``tokens()``/``result()`` instead of bare
+  ``RuntimeError`` strings;
+* the **health state machine** — :class:`Health`:
+  ``STARTING → READY → DRAINING → STOPPED``, plus ``OVERLOADED`` as a
+  READY-adjacent pressure state.  ``Engine.health()`` exposes it and the
+  ``serve.health`` gauge tracks every transition;
+* the **overload detector** — :class:`OverloadDetector`: queue depth
+  against a bounded queue plus estimated time-to-first-token from an
+  EWMA of tick duration.  The engine consults it at ``submit`` to drive
+  the shedding policy (``reject-new`` | ``drop-oldest``).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+__all__ = [
+    "DeadlineExceeded",
+    "EngineDraining",
+    "EngineOverloaded",
+    "Health",
+    "OverloadDetector",
+    "RecoveryFailed",
+    "RequestCancelled",
+    "RequestError",
+    "RequestPreempted",
+]
+
+
+class Health(enum.Enum):
+    """Engine lifecycle states.
+
+    ``STARTING`` — constructed, no tick executed yet (programs cold).
+    ``READY`` — serving; admission open.
+    ``OVERLOADED`` — serving, but the overload detector trips: new
+    submissions are shed per the engine's policy until pressure drops.
+    ``DRAINING`` — preemption observed: admission closed, in-flight work
+    finishing under the drain deadline.
+    ``STOPPED`` — drain complete; the engine no longer accepts work.
+    """
+
+    STARTING = "starting"
+    READY = "ready"
+    OVERLOADED = "overloaded"
+    DRAINING = "draining"
+    STOPPED = "stopped"
+
+
+class RequestError(RuntimeError):
+    """Base of every typed request/engine failure.
+
+    ``retryable`` is the client contract: True means the request itself
+    was fine and a retry (here after backoff, or against another
+    replica) is the right move; False means retrying the identical
+    request cannot help.
+    """
+
+    retryable: bool = False
+
+
+class DeadlineExceeded(RequestError):
+    """The request's ``deadline_s`` expired before completion.
+
+    Raised from the handle at the chunk boundary where the expiry was
+    observed; the request's pages were released there."""
+
+
+class RequestCancelled(RequestError):
+    """The client called :meth:`RequestHandle.cancel`."""
+
+
+class EngineOverloaded(RequestError):
+    """Shed by the overload policy (bounded queue / TTFT estimate)."""
+
+    retryable = True
+
+
+class EngineDraining(RequestError):
+    """Submission refused: the engine is DRAINING or STOPPED."""
+
+    retryable = True
+
+
+class RequestPreempted(RequestError):
+    """Failed by a drain: either flushed from the queue when drain
+    began, or still in flight when the drain deadline expired.  The
+    stream is *explicitly* truncated — retry against another replica."""
+
+    retryable = True
+
+
+class RecoveryFailed(RequestError):
+    """The crash-recovery supervisor exhausted the request's replay
+    budget (``max_recoveries``) without completing it."""
+
+    retryable = True
+
+
+class OverloadDetector:
+    """Admission-time overload signal: queue bound + TTFT estimate.
+
+    ``max_queue`` bounds waiting requests outright.  ``max_ttft_s``
+    bounds the *estimated* time a new arrival would wait for its
+    prefill: the queue must drain ahead of it at
+    ``max_prefills_per_tick`` per tick, so the estimate is
+    ``ceil((depth + 1) / max_prefills_per_tick) * ewma_tick_s``.  The
+    tick EWMA is seeded by the first observed tick and smoothed with
+    factor ``alpha``; compile-heavy warm-up ticks inflate it briefly and
+    decay out (the detector errs toward shedding while cold, which is
+    the safe direction).  Both knobs ``None`` → never overloaded, the
+    engine's default.
+    """
+
+    def __init__(
+        self,
+        max_queue: Optional[int] = None,
+        max_ttft_s: Optional[float] = None,
+        alpha: float = 0.2,
+    ):
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None for unbounded)")
+        if max_ttft_s is not None and max_ttft_s <= 0:
+            raise ValueError("max_ttft_s must be > 0 (or None to disable)")
+        self.max_queue = max_queue
+        self.max_ttft_s = max_ttft_s
+        self.alpha = alpha
+        self._tick_ewma_s: Optional[float] = None
+
+    def observe_tick(self, dur_s: float) -> None:
+        """Feed one engine-tick duration into the EWMA."""
+        if self._tick_ewma_s is None:
+            self._tick_ewma_s = dur_s
+        else:
+            self._tick_ewma_s += self.alpha * (dur_s - self._tick_ewma_s)
+
+    def est_ttft_s(self, queue_depth: int, max_prefills_per_tick: int) -> float:
+        """Estimated wait-for-prefill of a request arriving now."""
+        if self._tick_ewma_s is None:
+            return 0.0
+        ticks = -(-(queue_depth + 1) // max(1, max_prefills_per_tick))
+        return ticks * self._tick_ewma_s
+
+    def overloaded(self, queue_depth: int, max_prefills_per_tick: int) -> bool:
+        if self.max_queue is not None and queue_depth >= self.max_queue:
+            return True
+        if self.max_ttft_s is not None:
+            if self.est_ttft_s(queue_depth, max_prefills_per_tick) > self.max_ttft_s:
+                return True
+        return False
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_queue is not None or self.max_ttft_s is not None

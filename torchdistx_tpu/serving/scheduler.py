@@ -7,12 +7,26 @@ runs; raising it favors TTFT, lowering it favors decode throughput), and
 the backpressure rule: admission is head-of-line — if the head request's
 page reservation does not fit the allocator's free list, nothing is
 admitted this tick and the FIFO waits (no out-of-order admission, no
-partial grants, no crash).
+partial grants, no crash).  Both binding constraints are counted: a tick
+stalled on pages AND a tick stalled on decode slots bump
+``serve.backpressure`` (a slot-bound stall that telemetry cannot see is
+indistinguishable from a healthy idle engine).
+
+Lifecycle hooks (see :mod:`.lifecycle`): :meth:`FIFOScheduler.purge`
+drops cancelled/deadline-expired requests from the waiting side at each
+chunk boundary, :meth:`FIFOScheduler.requeue` puts requests back at the
+FIFO *head* after a transient prefill failure (order preserved),
+:meth:`FIFOScheduler.shed_oldest` implements the ``drop-oldest``
+overload policy, and :meth:`FIFOScheduler.flush` empties the queue when
+a drain begins.
 
 :class:`RequestHandle` is the streaming API: ``handle.tokens()`` yields
 tokens as the engine produces them, *driving* the engine while the caller
 iterates — no background thread, so runs are deterministic and the engine
-is single-threaded by construction (document, don't lock).
+is single-threaded by construction (document, don't lock).  A request
+that failed — cancelled, expired, shed, preempted by a drain, or beyond
+its recovery budget — raises its typed :class:`.lifecycle.RequestError`
+from ``tokens()``/``result()`` instead of truncating silently.
 """
 
 from __future__ import annotations
@@ -20,7 +34,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,13 +56,18 @@ class Request:
     max_new_tokens: int
     key: np.ndarray  # (2,) uint32 — the solo-generate-compatible PRNG key
     handle: "RequestHandle"
+    deadline: Optional[float] = None  # absolute perf_counter() expiry
     submit_t: float = dataclasses.field(default_factory=time.perf_counter)
     blocks: Optional[List[int]] = None  # pages owned while running
+    recoveries: int = 0  # replay budget consumed by the supervisor
 
     @property
     def cache_tokens(self) -> int:
         """KV slots this request reserves: every prompt + output position."""
         return len(self.prompt) + self.max_new_tokens
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
 
 
 class RequestHandle:
@@ -59,12 +78,24 @@ class RequestHandle:
         self.rid = rid
         self._tokens: List[int] = []
         self._done = False
+        self._cancel_requested = False
         self.ttft_s: Optional[float] = None
-        self.error: Optional[str] = None
+        self.error: Optional[BaseException] = None
 
     @property
     def done(self) -> bool:
         return self._done
+
+    def cancel(self) -> bool:
+        """Request cancellation.  Takes effect at the next chunk
+        boundary (waiting requests leave the queue, running requests
+        release their pages); the handle then raises
+        :class:`.lifecycle.RequestCancelled`.  Returns False (no-op) if
+        the request already finished."""
+        if self._done:
+            return False
+        self._cancel_requested = True
+        return True
 
     def _push(self, token: int) -> None:
         self._tokens.append(token)
@@ -72,18 +103,18 @@ class RequestHandle:
     def _finish(self) -> None:
         self._done = True
 
-    def _fail(self, msg: str) -> None:
-        """Abort the request (e.g. its KV was lost to a failed device
-        call): consumers see a ``RuntimeError`` instead of a silent
-        truncated stream."""
-        self.error = msg
+    def _fail(self, error: BaseException) -> None:
+        """Abort the request with a typed error (see :mod:`.lifecycle`):
+        consumers see the exception instead of a silently truncated
+        stream."""
+        self.error = error
         self._done = True
 
     def tokens(self) -> Iterator[int]:
         """Yield tokens as they are produced, stepping the engine while
         none are buffered.  Safe to interleave across handles — every
-        ``step()`` advances all running requests.  Raises if the request
-        was aborted."""
+        ``step()`` advances all running requests.  Raises the request's
+        typed error if it was aborted."""
         i = 0
         while True:
             while i < len(self._tokens):
@@ -91,9 +122,7 @@ class RequestHandle:
                 i += 1
             if self._done:
                 if self.error is not None:
-                    raise RuntimeError(
-                        f"request {self.rid} aborted: {self.error}"
-                    )
+                    raise self.error
                 return
             self._engine.step()
 
@@ -123,6 +152,50 @@ class FIFOScheduler:
         self._waiting.append(req)
         _G_QUEUE.set(len(self._waiting))
 
+    def requeue(self, reqs: List[Request]) -> None:
+        """Return ``reqs`` to the FIFO *head*, preserving their order —
+        a transient prefill failure must not cost a request its place."""
+        for req in reversed(reqs):
+            self._waiting.appendleft(req)
+        _G_QUEUE.set(len(self._waiting))
+
+    def shed_oldest(self) -> Optional[Request]:
+        """Pop the oldest waiting request (the ``drop-oldest`` overload
+        policy's victim), or None if the queue is empty."""
+        if not self._waiting:
+            return None
+        req = self._waiting.popleft()
+        _G_QUEUE.set(len(self._waiting))
+        return req
+
+    def flush(self) -> List[Request]:
+        """Empty the queue (drain start); returns the flushed requests."""
+        out = list(self._waiting)
+        self._waiting.clear()
+        _G_QUEUE.set(0)
+        return out
+
+    def purge(self, now: float) -> Tuple[List[Request], List[Request]]:
+        """Drop cancelled and deadline-expired requests from the waiting
+        side.  Returns ``(expired, cancelled)`` for the engine to fail
+        with their typed errors."""
+        expired: List[Request] = []
+        cancelled: List[Request] = []
+        if not self._waiting:
+            return expired, cancelled
+        keep: deque = deque()
+        for req in self._waiting:
+            if req.handle._cancel_requested:
+                cancelled.append(req)
+            elif req.expired(now):
+                expired.append(req)
+            else:
+                keep.append(req)
+        if expired or cancelled:
+            self._waiting = keep
+            _G_QUEUE.set(len(keep))
+        return expired, cancelled
+
     def pop_admissible(
         self,
         n_free_slots: int,
@@ -132,13 +205,16 @@ class FIFOScheduler:
         """Pop up to ``max_prefills_per_tick`` requests that fit the free
         slots AND whose cumulative page reservations fit the free list.
         Stops at the first head that doesn't fit (FIFO order is the
-        fairness guarantee; skipping ahead would starve long prompts)."""
+        fairness guarantee; skipping ahead would starve long prompts).
+        Every stalled tick with work waiting counts — whether pages or
+        slots are the binding constraint."""
         out: List[Request] = []
+        limit = min(self.max_prefills_per_tick, n_free_slots)
+        if self._waiting and limit == 0:
+            _T_BACKPRESSURE.add()  # slot-bound stall, visible like a page-bound one
+            return out
         free_pages = allocator.num_free
-        while (
-            self._waiting
-            and len(out) < min(self.max_prefills_per_tick, n_free_slots)
-        ):
+        while self._waiting and len(out) < limit:
             need = blocks_needed(self._waiting[0].cache_tokens, block_size)
             if need > free_pages:
                 _T_BACKPRESSURE.add()
