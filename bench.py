@@ -592,6 +592,115 @@ def bench_serving():
     }
 
 
+def bench_fleet_failover():
+    """Fleet resilience probe: failover + hot-swap cost under load.
+
+    Mixed traffic over a 2-engine :class:`~torchdistx_tpu.fleet
+    .FleetRouter`; one engine is killed (device failure + close) at 50%
+    of the pulls and a zero-downtime hot swap retires the survivor at
+    75%.  Reports completed / failed-typed counts (both failure counts
+    must be 0 — the probe injects no deadlines or cancels, so every
+    request must complete somewhere), the p95 pull latency of
+    failed-over vs clean requests and their delta (the failover tax:
+    backoff + re-submit + token-identical replay — measured from
+    sequential pulls, so queue position is in both groups' baseline),
+    and the hot-swap request-drop count, which must be 0.
+    """
+    import jax
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.fleet import FleetRouter, hot_swap
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import Engine, RequestError
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=512, n_layers=8, n_heads=8, n_kv_heads=8,
+        ffn_dim=2048, max_seq_len=512, remat=False,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_engine():
+        return Engine(
+            params, model=llama, cfg=cfg, num_slots=4, block_size=16,
+            max_model_len=256, decode_chunk=8, min_prefill_bucket=32,
+            handle_preemption=False,
+        )
+
+    # Warm the compiled programs on a throwaway engine (shared jit cache).
+    warm = make_engine()
+    wrng = np.random.default_rng(1)
+    for p in (32, 64, 128):
+        warm.submit(
+            wrng.integers(0, cfg.vocab_size, size=p).astype(np.int32),
+            max_new_tokens=4, key=0,
+        )
+    warm.drain()
+    warm.close()
+
+    rng = np.random.default_rng(0)
+    n_req = 32
+    eng_a, eng_b = make_engine(), make_engine()
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+    failovers_before = telemetry.counter("fleet.failovers").value
+    handles = []
+    for i in range(n_req):
+        plen = int(rng.integers(16, 97))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        mnt = int(rng.integers(32, 97))
+        handles.append(router.submit(prompt, max_new_tokens=mnt, key=i))
+
+    eng_c = {"eng": None}
+    swap_s = None
+    lat_clean, lat_failover = [], []
+    n_done = n_failed = 0
+    for idx, h in enumerate(handles):
+        if idx == n_req // 2:
+            for leaf in jax.tree.leaves(eng_a._cache):
+                leaf.delete()
+            eng_a.close()
+            router.poll()
+        if idx == (3 * n_req) // 4:
+            eng_c["eng"] = make_engine()
+            t0 = time.perf_counter()
+            hot_swap(router, lambda: eng_c["eng"], version="v2")
+            swap_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        try:
+            h.result()
+            n_done += 1
+            (lat_failover if h.hops else lat_clean).append(
+                time.perf_counter() - t0
+            )
+        except RequestError:
+            n_failed += 1
+
+    out = {
+        "n_requests": n_req,
+        "completed": n_done,
+        "failed_typed": n_failed,  # must be 0: no deadlines/cancels here
+        "hot_swap_dropped": n_failed,  # the acceptance number (must be 0)
+        "hot_swap_s": round(swap_s, 3) if swap_s is not None else None,
+        "failovers": telemetry.counter("fleet.failovers").value
+        - failovers_before,
+    }
+    if lat_clean:
+        out["clean_pull_p95_s"] = round(
+            float(np.percentile(lat_clean, 95)), 4
+        )
+    if lat_failover:
+        out["failover_pull_p95_s"] = round(
+            float(np.percentile(lat_failover, 95)), 4
+        )
+    if lat_clean and lat_failover:
+        out["failover_added_latency_p95_s"] = round(
+            float(np.percentile(lat_failover, 95))
+            - float(np.percentile(lat_clean, 95)),
+            4,
+        )
+    return out
+
+
 def bench_flash_attention(s=16384, b=1, h=8, d=128):
     """Long-context flash attention fwd+bwd at S=16k on one chip.
 
@@ -710,6 +819,10 @@ def main():
                 )
     except Exception as e:  # noqa: BLE001
         serving = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        fleet = bench_fleet_failover()
+    except Exception as e:  # noqa: BLE001
+        fleet = {"error": f"{type(e).__name__}: {e}"}
     # Second flash probe, minutes after the first (same compiled program,
     # deterministic work): tunnel windows last minutes, so two temporally
     # separated samples of the same measurement keep one bad window from
@@ -754,6 +867,7 @@ def main():
                     "flash_attention_16k": flash16k,
                     "generate_llama_350m_decode": gen,
                     "serving_llama_350m_continuous": serving,
+                    "fleet_failover": fleet,
                     "cold_uncached_s": cold,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),

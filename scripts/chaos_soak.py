@@ -22,11 +22,22 @@ Finally the exported telemetry trace must record the recoveries: the
 ``serve.recover`` and ``serve.drain`` spans and a
 ``serve.recoveries >= 1`` counter snapshot.
 
-CI (.github/workflows/ci.yaml, chaos-soak job) runs this with
-``TDX_TELEMETRY`` set.  Locally:
+**Fleet mode** (``python scripts/chaos_soak.py fleet``, ISSUE 6
+acceptance gate): the same mixed traffic runs against a
+:class:`~torchdistx_tpu.fleet.FleetRouter` over two engines — greedy
+and sampled sub-phases — with one engine **killed mid-load** (device
+failure: its page pool deleted, then ``close()``) and one **hot swap**
+triggered under the remaining load.  Every request must complete
+token-identical to solo ``generate()`` on SOME replica or fail typed by
+its own deadline/cancel — zero requests lost to infrastructure — with
+zero leaked pages on every replica, and the exported trace must show
+the ``fleet.swap`` span and ``fleet.failovers >= 1``.
+
+CI (.github/workflows/ci.yaml, chaos-soak + fleet-chaos jobs) runs both
+modes with ``TDX_TELEMETRY`` set.  Locally:
 
     TDX_TELEMETRY=/tmp/chaos.jsonl JAX_PLATFORMS=cpu \\
-    python scripts/chaos_soak.py
+    python scripts/chaos_soak.py [fleet]
 """
 
 import json
@@ -46,6 +57,19 @@ MAX_STEPS = 60 * N_REQUESTS
 def fail(msg: str) -> int:
     print(f"chaos_soak: FAIL — {msg}", file=sys.stderr)
     return 1
+
+
+def parse_trace(path):
+    """Span names + merged counter snapshots from a JSONL trace."""
+    spans, counters = set(), {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "span":
+                spans.add(rec["name"])
+            elif rec.get("type") == "counters":
+                counters.update(rec.get("values", {}))
+    return spans, counters
 
 
 def main() -> int:
@@ -220,14 +244,7 @@ def main() -> int:
 
     # ---------------- Trace assertions ----------------
     telemetry.emit_counters()
-    spans, counters = set(), {}
-    with open(trace) as f:
-        for line in f:
-            rec = json.loads(line)
-            if rec.get("type") == "span":
-                spans.add(rec["name"])
-            elif rec.get("type") == "counters":
-                counters.update(rec.get("values", {}))
+    spans, counters = parse_trace(trace)
     missing = {"serve.recover", "serve.drain", "serve.prefill", "serve.step"} - spans
     if missing:
         return fail(f"trace missing spans {missing}")
@@ -246,5 +263,187 @@ def main() -> int:
     return 0
 
 
+def fleet_main() -> int:
+    """Fleet chaos (ISSUE 6): kill an engine mid-load, hot-swap under
+    load, assert zero silent loss and zero leaked pages everywhere."""
+    trace = os.environ.get("TDX_TELEMETRY", "")
+    if not trace:
+        print("chaos_soak: set TDX_TELEMETRY", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.fleet import FleetRouter, hot_swap
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.models.generate import generate
+    from torchdistx_tpu.serving import (
+        DeadlineExceeded,
+        Engine,
+        RequestCancelled,
+        RequestError,
+    )
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(SEED)
+    budgets = (4, 8, 12)
+
+    solo_cache = {}
+
+    def solo(prompt, key, max_new, temperature, top_k):
+        k = (prompt.tobytes(), key, max_new, temperature, top_k)
+        if k not in solo_cache:
+            toks = [
+                int(t) for t in np.asarray(
+                    generate(
+                        params, prompt[None], jax.random.PRNGKey(key),
+                        model=llama, cfg=cfg, max_new_tokens=max_new,
+                        eos_id=EOS, temperature=temperature, top_k=top_k,
+                    )
+                )[0]
+            ]
+            if EOS in toks:
+                toks = toks[: toks.index(EOS) + 1]
+            solo_cache[k] = toks
+        return solo_cache[k]
+
+    def make_engine(temperature, top_k):
+        return Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+            block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+            temperature=temperature, top_k=top_k, drain_deadline_s=120.0,
+            handle_preemption=False,
+        )
+
+    def phase(label, temperature, top_k, n, key_base):
+        """One fleet sub-phase: n mixed requests over 2 engines, kill A
+        at 50% of the pulls, hot-swap the survivor at 75%.  Returns an
+        error string or None."""
+        eng_a = make_engine(temperature, top_k)
+        eng_b = make_engine(temperature, top_k)
+        router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+        reqs = []
+        for i in range(n):
+            plen = int(rng.integers(3, 14))
+            prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(
+                np.int32
+            )
+            mnt = int(rng.choice(budgets))
+            deadline = None if rng.random() > 0.05 else 1e-6
+            h = router.submit(
+                prompt, max_new_tokens=mnt, key=key_base + i,
+                deadline_s=deadline,
+            )
+            if rng.random() < 0.05:
+                h.cancel()
+            reqs.append((prompt, mnt, key_base + i, h))
+
+        eng_c = {"eng": None}
+        n_ok = n_typed = 0
+        for idx, (prompt, mnt, key, h) in enumerate(reqs):
+            if idx == n // 2:
+                # Kill A mid-load: device failure (pool consumed) + close.
+                for leaf in jax.tree.leaves(eng_a._cache):
+                    leaf.delete()
+                eng_a.close()
+                router.poll()
+            if idx == (3 * n) // 4:
+                # Upgrade under the remaining load.  Same weights (an
+                # operational upgrade drill): every stream still checks
+                # against one solo oracle, whichever version served it.
+                eng_c["eng"] = make_engine(temperature, top_k)
+                hot_swap(router, lambda: eng_c["eng"], version="v2")
+            try:
+                toks = h.result()
+            except RequestError:
+                pass
+            if not h.done:
+                return f"[{label}] request {key} neither finished nor failed"
+            if h.error is not None:
+                if not isinstance(h.error, RequestError):
+                    return (
+                        f"[{label}] request {key} failed UNTYPED: "
+                        f"{type(h.error).__name__}: {h.error}"
+                    )
+                if not isinstance(
+                    h.error, (DeadlineExceeded, RequestCancelled)
+                ):
+                    # Anything but the client's own deadline/cancel is a
+                    # request LOST to infrastructure — the router's job
+                    # was to retry it to completion.
+                    return (
+                        f"[{label}] request {key} lost to infrastructure: "
+                        f"{h.error!r}"
+                    )
+                n_typed += 1
+            else:
+                if toks != solo(prompt, key, mnt, temperature, top_k):
+                    return (
+                        f"[{label}] request {key} diverged from solo "
+                        "generate()"
+                    )
+                n_ok += 1
+        for name, eng in (
+            ("A", eng_a), ("B", eng_b), ("C", eng_c["eng"]),
+        ):
+            if eng is not None and eng.allocator.num_in_use != 0:
+                return (
+                    f"[{label}] replica {name} leaked "
+                    f"{eng.allocator.num_in_use} pages"
+                )
+        versions = [r.version for r in router.replicas()]
+        if versions != ["v2"]:
+            return f"[{label}] fleet did not converge on v2: {versions}"
+        print(
+            f"chaos_soak: fleet {label} OK — {n_ok} token-identical, "
+            f"{n_typed} typed deadline/cancel failures "
+            f"(n={n}, failovers so far="
+            f"{telemetry.counter('fleet.failovers').value})"
+        )
+        return None
+
+    n = max(2, N_REQUESTS // 2)
+    err = phase("greedy", 0.0, None, n, key_base=0)
+    if err is None:
+        err = phase("sampled", 0.7, 8, n, key_base=10_000)
+    if err is not None:
+        return fail(err)
+    if telemetry.counter("fleet.failovers").value < 1:
+        return fail("fleet soak produced no failovers")
+
+    # ---------------- Trace assertions ----------------
+    telemetry.emit_counters()
+    spans, counters = parse_trace(trace)
+    missing = {"fleet.swap", "serve.drain", "serve.prefill"} - spans
+    if missing:
+        return fail(f"trace missing spans {missing}")
+    if counters.get("fleet.failovers", 0) < 1:
+        return fail(
+            "trace shows no fleet.failovers "
+            f"({ {k: v for k, v in counters.items() if k.startswith('fleet')} })"
+        )
+    if counters.get("fleet.submitted", 0) < 2 * n:
+        return fail(
+            f"trace shows fleet.submitted={counters.get('fleet.submitted')}"
+            f" < {2 * n}"
+        )
+    if counters.get("fleet.swaps", 0) < 2:
+        return fail(f"trace shows fleet.swaps={counters.get('fleet.swaps')}")
+    print(
+        "chaos_soak: fleet trace OK — "
+        f"submitted={counters.get('fleet.submitted')}, "
+        f"failovers={counters.get('fleet.failovers')}, "
+        f"swaps={counters.get('fleet.swaps')}, "
+        f"hops_exhausted={counters.get('fleet.hops_exhausted', 0)}"
+    )
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        sys.exit(fleet_main())
     sys.exit(main())

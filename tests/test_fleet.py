@@ -1,0 +1,463 @@
+"""Fleet layer (ISSUE 6): health-aware routing, typed failover, hot swap.
+
+A :class:`~torchdistx_tpu.fleet.FleetRouter` fronting N engines must
+route on per-engine health/TTFT, fail retryable typed errors over to
+peers token-identically (greedy AND sampled), pin mid-stream failovers
+to the weights version that produced the yielded prefix, fail typed —
+never silently — when no replica can take a request, and hot-swap to a
+deferred-init-materialized standby with zero dropped requests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.fleet import (
+    FailoverDiverged,
+    FailoverExhausted,
+    FleetRouter,
+    NoReplicaAvailable,
+    hot_swap,
+    materialize_standby,
+)
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.models.generate import generate
+from torchdistx_tpu.resilience import faults, preemption
+from torchdistx_tpu.serving import (
+    DeadlineExceeded,
+    Engine,
+    EngineOverloaded,
+    Health,
+    RequestCancelled,
+    RequestError,
+)
+
+EOS = 5
+ENGINE_KW = dict(
+    num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
+    handle_preemption=False,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_preemption():
+    preemption.clear()
+    yield
+    preemption.clear()
+    faults.reset("")
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def solo(model, cfg, params, prompt, seed, max_new, *, eos=None,
+         temperature=0.0, top_k=None):
+    out = generate(
+        params, jnp.asarray(prompt)[None], jax.random.PRNGKey(seed),
+        model=model, cfg=cfg, max_new_tokens=max_new, eos_id=eos,
+        temperature=temperature, top_k=top_k,
+    )
+    toks = [int(t) for t in np.asarray(out)[0]]
+    if eos is not None and eos in toks:
+        toks = toks[: toks.index(eos) + 1]
+    return toks
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def make_engine(family, **over):
+    model, cfg, params = family
+    kw = {**ENGINE_KW, **over}
+    return Engine(params, model=model, cfg=cfg, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Routing policy
+
+
+def test_routes_to_least_estimated_ttft(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    # Seed the detectors: A looks slow, B fast — the router must read the
+    # PER-ENGINE estimate (the global gauge would be whichever wrote last).
+    eng_a.detector.observe_tick(0.5)
+    eng_b.detector.observe_tick(0.01)
+    h1 = router.submit(prompt_of(4), max_new_tokens=2, key=0)
+    assert h1.replica_id == 1
+    # Load tiebreak when estimates match: the next request moves off the
+    # loaded replica instead of piling onto the lowest replica id.
+    eng_a.detector._tick_ewma_s = eng_b.detector._tick_ewma_s = None
+    h2 = router.submit(prompt_of(4), max_new_tokens=2, key=1)
+    assert h2.replica_id == 0
+    for h in (h1, h2):
+        assert len(h.result()) == 2
+    assert eng_a.allocator.num_in_use == 0
+    assert eng_b.allocator.num_in_use == 0
+
+
+def test_overloaded_avoided_draining_excluded(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    # B overloaded: avoided while A is healthy...
+    eng_b._set_health(Health.OVERLOADED)
+    assert router._pick().rid == 0
+    # ...but the last resort once A stops admitting.
+    eng_a.begin_drain()
+    assert router._pick().rid == 1
+    # DRAINING/STOPPED never route: with B draining too, submission
+    # fails TYPED, not silently.
+    eng_b._set_health(Health.READY)
+    eng_b.begin_drain()
+    with pytest.raises(NoReplicaAvailable) as ei:
+        router.submit(prompt_of(4), max_new_tokens=2, key=0)
+    assert ei.value.retryable
+    while eng_a.health() is not Health.STOPPED or (
+        eng_b.health() is not Health.STOPPED
+    ):
+        router.step()
+    assert router.replicas() == []  # step() reaped the stopped replicas
+
+
+def test_replicas_ready_gauge_and_respawn(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    assert telemetry.gauge("fleet.replicas_ready").value == 2
+    eng_a.close()
+    assert router.poll() == [0]
+    assert telemetry.gauge("fleet.replicas_ready").value == 1
+    # The fleet heals by respawn: a replacement replica takes traffic.
+    rid = router.add_replica(make_engine(family), version="v1")
+    assert telemetry.gauge("fleet.replicas_ready").value == 2
+    eng_b.begin_drain()
+    h = router.submit(prompt_of(4), max_new_tokens=3, key=0)
+    assert h.replica_id == rid
+    assert len(h.result()) == 3
+
+
+# ---------------------------------------------------------------------------
+# Failover
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k", [(0.0, None), (0.8, 8)], ids=["greedy", "sampled"]
+)
+def test_midstream_failover_token_identical(family, temperature, top_k):
+    """The money path: a stream mid-flight on a replica that dies must
+    continue on a peer with not one token lost, duplicated, or changed
+    — greedy and sampled — because the replay re-derives the identical
+    stream from the pinned key and the verified prefix is skipped."""
+    model, cfg, params = family
+    eng_a = make_engine(family, temperature=temperature, top_k=top_k,
+                        eos_id=EOS)
+    eng_b = make_engine(family, temperature=temperature, top_k=top_k,
+                        eos_id=EOS)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    before = telemetry.counter("fleet.failovers").value
+    h = router.submit(prompt_of(6), max_new_tokens=10, key=3)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    eng_a.close()  # the serving replica dies mid-stream
+    rest = list(g)  # ...and the same iterator keeps streaming
+    expect = solo(model, cfg, params, prompt_of(6), 3, 10, eos=EOS,
+                  temperature=temperature, top_k=top_k)
+    assert first + rest == expect
+    assert h.replica_id == 1 and h.version == "v1" and h.hops == 1
+    assert telemetry.counter("fleet.failovers").value == before + 1
+    assert eng_a.allocator.num_in_use == 0
+    assert eng_b.allocator.num_in_use == 0
+
+
+def test_queued_work_reroutes_on_drain(family):
+    """begin_drain() flushes a replica's queue with retryable errors;
+    the router re-places that work on a peer — nothing is dropped."""
+    model, cfg, params = family
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    handles = [
+        router.submit(prompt_of(5, base=i + 1), max_new_tokens=4, key=i)
+        for i in range(6)
+    ]
+    on_a = [h for h in handles if h.replica_id == 0]
+    assert on_a  # routing spread work onto A
+    eng_a.begin_drain()
+    for i, h in enumerate(handles):
+        assert h.result() == solo(
+            model, cfg, params, prompt_of(5, base=i + 1), i, 4
+        )
+    assert all(h.replica_id == 1 for h in on_a)  # re-routed, completed
+    while eng_a.health() is not Health.STOPPED:
+        eng_a.step()
+    assert eng_a.allocator.num_in_use == 0
+    assert eng_b.allocator.num_in_use == 0
+
+
+def test_hop_budget_exhausted_fails_typed(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=0)
+    before = telemetry.counter("fleet.hops_exhausted").value
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=0)
+    g = h.tokens()
+    next(g)
+    eng_a.close()
+    with pytest.raises(FailoverExhausted) as ei:
+        list(g)
+    assert ei.value.retryable
+    assert isinstance(ei.value.__cause__, RequestError)
+    assert ei.value.__cause__.retryable
+    assert h.done and h.error is ei.value
+    assert telemetry.counter("fleet.hops_exhausted").value == before + 1
+    # A terminally failed handle re-raises, it does not resurrect.
+    with pytest.raises(FailoverExhausted):
+        h.result()
+
+
+def test_sole_replica_retried_after_transient_rejection(family):
+    """A single-replica fleet must RETRY its replica (with backoff,
+    under the hop budget) after a transient rejection — not fail
+    NoReplicaAvailable because the one candidate was just excluded."""
+    model, cfg, params = family
+    eng = make_engine(family)
+    router = FleetRouter([eng], version="v1", max_hops=3)
+    real_submit = eng.submit
+    state = {"n": 0}
+
+    def shed_once(*args, **kwargs):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise EngineOverloaded("transient shed; retry with backoff")
+        return real_submit(*args, **kwargs)
+
+    eng.submit = shed_once
+    h = router.submit(prompt_of(5), max_new_tokens=4, key=0)
+    assert h.hops == 1  # one backoff hop, same replica, success
+    assert h.result() == solo(model, cfg, params, prompt_of(5), 0, 4)
+    # A persistent rejection still exhausts the budget TYPED.
+    eng2 = make_engine(family)
+    router2 = FleetRouter([eng2], version="v1", max_hops=2)
+
+    def always_shed(*args, **kwargs):
+        raise EngineOverloaded("still overloaded")
+
+    eng2.submit = always_shed
+    with pytest.raises(FailoverExhausted):
+        router2.submit(prompt_of(5), max_new_tokens=4, key=0)
+
+
+def test_failover_divergence_fails_typed(family):
+    """A replay on a peer whose weights differ (a mislabeled version —
+    the parity invariant broken) must fail typed, whether the replay
+    MISMATCHES the yielded prefix or ends SHORTER than it — never a
+    silent splice or truncation."""
+    model, cfg, params = family
+    other = llama.init_params(jax.random.PRNGKey(99), cfg)
+    eng_a = make_engine(family)
+    eng_b = Engine(other, model=model, cfg=cfg, **ENGINE_KW)
+    router = FleetRouter([eng_a], version="v1")
+    router.add_replica(eng_b, version="v1")  # lies about its weights
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=0)
+    g = h.tokens()
+    consumed = [next(g), next(g)]
+    assert consumed == solo(model, cfg, params, prompt_of(6), 0, 8)[:2]
+    eng_a.close()
+    with pytest.raises(FailoverDiverged) as ei:
+        list(g)
+    assert not ei.value.retryable
+    assert h.done and h.error is ei.value
+    eng_b.step()  # the divergence guard cancelled the bad replay...
+    assert eng_b.allocator.num_in_use == 0  # ...and its pages came back
+
+
+def test_midstream_failover_is_version_pinned(family):
+    """A stream that already yielded v1 tokens must NOT continue on a
+    v2 replica: with every v1 replica gone it fails typed — two model
+    versions never interleave within one stream."""
+    model, cfg, params = family
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a], version="v1")
+    router.add_replica(eng_b, version="v2")
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=0)
+    assert h.version == "v1"
+    g = h.tokens()
+    next(g)
+    eng_a.close()
+    with pytest.raises(NoReplicaAvailable) as ei:
+        list(g)
+    assert ei.value.retryable
+    assert "version" in str(ei.value)
+    # A FRESH request (nothing yielded yet) crosses versions freely.
+    h2 = router.submit(prompt_of(4), max_new_tokens=3, key=1)
+    assert h2.version == "v2"
+    assert h2.result() == solo(model, cfg, params, prompt_of(4), 1, 3)
+
+
+def test_cancelled_request_does_not_fail_over(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    h = router.submit(prompt_of(6), max_new_tokens=20, key=0)
+    g = h.tokens()
+    next(g)
+    assert h.cancel()
+    with pytest.raises(RequestCancelled):
+        list(g)
+    assert h.hops == 0  # the client's own cancel is not an infra failure
+    assert not h.cancel()  # post-completion cancel is a reported no-op
+    assert eng_a.allocator.num_in_use == 0
+    assert eng_b.allocator.num_in_use == 0
+
+
+def test_fleet_deadline_spans_hops(family):
+    """The fleet-level deadline keeps ticking across failovers: a
+    re-route cannot grant a request more wall clock than it was given."""
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=0,
+                      deadline_s=60.0)
+    g = h.tokens()
+    next(g)
+    h._deadline = 0.0  # force expiry deterministically (no sleeps)
+    eng_a.close()
+    with pytest.raises(DeadlineExceeded):
+        list(g)
+    assert isinstance(h.error, DeadlineExceeded)
+    assert eng_b.allocator.num_in_use == 0  # never re-submitted
+
+
+# ---------------------------------------------------------------------------
+# Hot swap
+
+
+def test_hot_swap_zero_drop_under_load(family):
+    """A weight upgrade under load: v2 params are deferred-init
+    recorded and materialized while v1 serves, admission flips, v1
+    drains.  Zero requests dropped; in-flight streams finish on v1;
+    queued + fresh work completes on v2; no stream mixes versions."""
+    transformers = pytest.importorskip("transformers")
+    from torchdistx_tpu.models import convert
+
+    config = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attn_implementation="eager",
+    )
+    cfg = convert.llama_config_from_hf(config, dtype=jnp.float32, remat=False)
+    params_v1 = llama.init_params(jax.random.PRNGKey(7), cfg)
+    kw = dict(model=llama, cfg=cfg, **ENGINE_KW)
+    eng_v1 = Engine(params_v1, **kw)
+    router = FleetRouter([eng_v1], version="v1")
+
+    # In-flight on v1: consume two tokens mid-stream.
+    h_live = router.submit(prompt_of(6), max_new_tokens=8, key=0)
+    g = h_live.tokens()
+    first = [next(g), next(g)]
+    # Queued on v1 (slots full before these admit).
+    h_queued = [
+        router.submit(prompt_of(5, base=i + 2), max_new_tokens=5, key=10 + i)
+        for i in range(3)
+    ]
+
+    # v2: the paper's standby path — record under deferred_init (zero
+    # allocation), materialize to jax.Arrays, convert to the family tree.
+    params_v2 = materialize_standby(
+        transformers.LlamaForCausalLM, config,
+        convert=lambda arrays: convert.llama_params_from_hf(arrays, cfg),
+    )
+    before_swaps = telemetry.counter("fleet.swaps").value
+    prev = telemetry.configure(collect=True)
+    try:
+        hot_swap(router, lambda: Engine(params_v2, **kw), version="v2")
+        span_names = {s["name"] for s in telemetry.snapshot()["spans"]}
+        assert "fleet.swap" in span_names
+    finally:
+        telemetry.configure(**prev)
+    assert telemetry.counter("fleet.swaps").value == before_swaps + 1
+
+    # The in-flight stream finished on its ORIGINAL engine: pure v1.
+    rest = list(g)
+    assert first + rest == solo(llama, cfg, params_v1, prompt_of(6), 0, 8)
+    assert h_live.version == "v1" and h_live.hops == 0
+    # Queued work was flushed by the drain and re-routed: pure v2.
+    for i, h in enumerate(h_queued):
+        assert h.result() == solo(
+            llama, cfg, params_v2, prompt_of(5, base=i + 2), 10 + i, 5
+        )
+        assert h.version == "v2" and h.hops >= 1
+    # Fresh work lands on v2; v1 is drained, closed, and gone.
+    h_new = router.submit(prompt_of(4), max_new_tokens=3, key=99)
+    assert h_new.result() == solo(llama, cfg, params_v2, prompt_of(4), 99, 3)
+    assert [r.version for r in router.replicas()] == ["v2"]
+    assert eng_v1.health() is Health.STOPPED
+    assert eng_v1.allocator.num_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# Mini fleet chaos (the CI-scale soak lives in scripts/chaos_soak.py)
+
+
+def test_fleet_mini_chaos_kill_and_swap(family):
+    """Mixed traffic over 2 engines; one is killed mid-load (device
+    failure + close) and a hot-swap retires the other: every request
+    completes token-identical to solo generate() on SOME replica or
+    fails typed by its own deadline/cancel — infrastructure loss is
+    zero — and no replica leaks a page."""
+    model, cfg, params = family
+    rng = np.random.default_rng(42)
+    kw = dict(eos_id=EOS)
+    eng_a, eng_b = make_engine(family, **kw), make_engine(family, **kw)
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+    before_failovers = telemetry.counter("fleet.failovers").value
+
+    reqs = []
+    for i in range(28):
+        plen = int(rng.integers(3, 14))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        mnt = int(rng.choice([4, 8, 12]))
+        deadline = None if rng.random() > 0.1 else 1e-6
+        h = router.submit(prompt, max_new_tokens=mnt, key=i,
+                          deadline_s=deadline)
+        if rng.random() < 0.1:
+            h.cancel()
+        reqs.append((prompt, mnt, i, h))
+
+    eng_c = None
+    for idx, (prompt, mnt, key, h) in enumerate(reqs):
+        if idx == 10:
+            # Kill A mid-load: the device fails (pool consumed), the
+            # replica is closed — its work must re-route, not vanish.
+            for leaf in jax.tree.leaves(eng_a._cache):
+                leaf.delete()
+            eng_a.close()
+            assert router.poll() == [0]
+        if idx == 18:
+            # Upgrade under the remaining load (same weights: every
+            # surviving stream still compares against one solo oracle).
+            eng_c = make_engine(family, **kw)
+            hot_swap(router, lambda: eng_c, version="v2")
+        try:
+            toks = h.result()
+        except RequestError:
+            pass
+        assert h.done, f"request {key} neither finished nor failed"
+        if h.error is not None:
+            assert isinstance(
+                h.error, (DeadlineExceeded, RequestCancelled)
+            ), f"request {key} lost to infrastructure: {h.error!r}"
+        else:
+            assert toks == solo(
+                model, cfg, params, prompt, key, mnt, eos=EOS
+            ), f"request {key} diverged from solo generate()"
+
+    n_ok = sum(h.error is None for *_, h in reqs)
+    assert n_ok >= 15, "chaos failed almost everything — soak too aggressive"
+    assert telemetry.counter("fleet.failovers").value > before_failovers
+    for eng in (eng_a, eng_b, eng_c):
+        assert eng.allocator.num_in_use == 0, "pages leaked"
+    assert [r.version for r in router.replicas()] == ["v2"]

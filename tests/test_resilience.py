@@ -25,6 +25,7 @@ from torchdistx_tpu.resilience import (
     parse_faults,
     preemption,
 )
+from torchdistx_tpu.resilience.retry import DEFAULT_RETRYABLE_NAMES
 
 
 @pytest.fixture(autouse=True)
@@ -88,6 +89,77 @@ class TestRetryPolicy:
         p = RetryPolicy(max_attempts=2, base_delay_s=0.001)
         assert p.is_retryable(Unavailable())
         assert not p.is_retryable(KeyError())
+
+    def test_explicit_retryable_attribute_is_authoritative(self):
+        """An exception carrying a boolean `retryable` (the serving
+        RequestError contract) overrides BOTH the isinstance layer and
+        the name layer — the router, checkpoint IO, and data IO all
+        classify through this one path."""
+        p = RetryPolicy(max_attempts=2, base_delay_s=0.001)
+
+        class TransientThing(Exception):  # not an OSError, unknown name
+            retryable = True
+
+        class FatalIO(OSError):  # isinstance says retry; raiser says no
+            retryable = False
+
+        assert p.is_retryable(TransientThing())
+        assert not p.is_retryable(FatalIO())
+        # A non-boolean attribute is ignored — heuristics still apply.
+        class WeirdAttr(OSError):
+            retryable = "yes"
+
+        assert p.is_retryable(WeirdAttr())
+
+    def test_retryable_attribute_request_error_contract(self):
+        """End-to-end with the serving taxonomy: a shed/drain is
+        retryable; a serving DeadlineExceeded is NOT, even though its
+        NAME collides with grpc's transient DeadlineExceeded status."""
+        from torchdistx_tpu.serving import (
+            DeadlineExceeded,
+            EngineDraining,
+            EngineOverloaded,
+            RequestCancelled,
+        )
+
+        p = RetryPolicy(max_attempts=2, base_delay_s=0.001)
+        assert p.is_retryable(EngineOverloaded("shed"))
+        assert p.is_retryable(EngineDraining("draining"))
+        assert not p.is_retryable(RequestCancelled("client cancel"))
+        assert not p.is_retryable(DeadlineExceeded("too late"))
+        assert "DeadlineExceeded" in DEFAULT_RETRYABLE_NAMES  # the trap
+
+    def test_retryable_attribute_drives_call(self):
+        """call() grants retries on attribute-classified exceptions and
+        stops immediately on retryable=False ones."""
+        p = RetryPolicy(max_attempts=3, base_delay_s=0.001)
+
+        class Transient(Exception):
+            retryable = True
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise Transient("hiccup")
+            return "ok"
+
+        assert p.call(flaky) == "ok"
+        assert len(calls) == 2
+
+        class Fatal(OSError):
+            retryable = False
+
+        fatal_calls = []
+
+        def fatal():
+            fatal_calls.append(1)
+            raise Fatal("corrupt")
+
+        with pytest.raises(Fatal):
+            p.call(fatal)
+        assert len(fatal_calls) == 1
 
     def test_delay_backoff_bounds(self):
         p = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, jitter=0.5)

@@ -140,7 +140,9 @@ def test_drain_emits_span_and_counters(family):
             eng.step()
         names = {s["name"] for s in telemetry.snapshot()["spans"]}
         assert "serve.drain" in names
-        assert telemetry.gauge("serve.health").value == "stopped"
+        # STOPPED clears the routing gauges (they are process-global; a
+        # dead engine must not leave readings for a router to act on).
+        assert telemetry.gauge("serve.health").value is None
     finally:
         telemetry.configure(**prev)
 
@@ -417,6 +419,104 @@ def test_prefill_failure_keeps_fifo_order(monkeypatch, family):
     eng.drain()
     assert ha.result() == solo(model, cfg, params, prompt_of(4, base=1), 0, 4)
     assert hb.result() == solo(model, cfg, params, prompt_of(4, base=2), 1, 4)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_stopped_engine_clears_routing_gauges(family):
+    """A router (or an operator tailing the trace) load-balances on the
+    serve.health / serve.est_ttft_s gauges; they are process-global, so
+    an engine reaching STOPPED must CLEAR them — stale readings from a
+    dead engine would masquerade as a live replica's."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, max_queue=64, **ENGINE_KW
+    )
+    eng.submit(prompt_of(4), max_new_tokens=2, key=0)
+    eng.step()  # detector enabled → est_ttft gauge written this tick
+    assert telemetry.gauge("serve.health").value == "ready"
+    assert telemetry.gauge("serve.est_ttft_s").value is not None
+    eng.drain()
+    eng.close()
+    assert eng.health() is Health.STOPPED
+    assert telemetry.gauge("serve.health").value is None
+    assert telemetry.gauge("serve.est_ttft_s").value is None
+    # The graceful-drain exit clears them too, not just close().
+    eng2 = Engine(
+        params, model=model, cfg=cfg, max_queue=64, **ENGINE_KW
+    )
+    eng2.submit(prompt_of(4), max_new_tokens=2, key=0)
+    eng2.step()
+    assert telemetry.gauge("serve.health").value == "ready"
+    preemption.request()
+    while eng2.health() is not Health.STOPPED:
+        eng2.step()
+    assert telemetry.gauge("serve.health").value is None
+    assert telemetry.gauge("serve.est_ttft_s").value is None
+
+
+def test_est_ttft_hook_matches_detector(family):
+    """Engine.est_ttft_s() is the per-engine router hook behind the
+    process-global gauge — it must track the detector's estimate for
+    the engine's own queue depth."""
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, max_queue=64, **ENGINE_KW)
+    assert eng.est_ttft_s() == 0.0  # no tick observed yet
+    eng.detector.observe_tick(0.5)
+    assert eng.est_ttft_s() == pytest.approx(0.5)  # empty queue: 1 tick
+    eng.submit(prompt_of(4), max_new_tokens=2, key=0)
+    eng.submit(prompt_of(4), max_new_tokens=2, key=1)
+    assert eng.est_ttft_s() == pytest.approx(
+        eng.detector.est_ttft_s(2, eng.max_prefills_per_tick)
+    )
+    eng.drain()
+    assert eng.allocator.num_in_use == 0
+
+
+def test_close_is_idempotent_and_post_stopped_rejects_typed(family):
+    """Engine.close() twice must not double-fail anything (counters
+    unchanged on the second call), and a STOPPED engine must reject
+    submit()/step() with the typed, retryable EngineDraining — the
+    contract the fleet router's failover relies on."""
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    running = eng.submit(prompt_of(6), max_new_tokens=30, key=0)
+    eng.step()
+    queued = eng.submit(prompt_of(5), max_new_tokens=4, key=1)
+    before_preempted = telemetry.counter("serve.preempted").value
+    eng.close()
+    assert eng.health() is Health.STOPPED
+    assert running.done and queued.done
+    after_first = telemetry.counter("serve.preempted").value
+    assert after_first == before_preempted + 2
+    eng.close()  # idempotent: nothing re-failed, nothing re-counted
+    assert telemetry.counter("serve.preempted").value == after_first
+    assert eng.stats()["preempted"] == 2
+    with pytest.raises(EngineDraining) as ei:
+        eng.submit(prompt_of(4), max_new_tokens=2, key=2)
+    assert ei.value.retryable
+    with pytest.raises(EngineDraining):
+        eng.step()
+    assert eng.allocator.num_in_use == 0
+
+
+def test_begin_drain_without_signal(family):
+    """begin_drain() — the fleet hot-swap hook — walks the same path a
+    SIGTERM does: queue flushed retryably, in-flight work finishes,
+    STOPPED at the end; idempotent while draining."""
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, drain_deadline_s=60.0,
+                 **ENGINE_KW)
+    running = eng.submit(prompt_of(6), max_new_tokens=6, key=0)
+    eng.step()
+    waiting = eng.submit(prompt_of(5), max_new_tokens=4, key=1)
+    eng.begin_drain()
+    assert eng.health() is Health.DRAINING
+    eng.begin_drain()  # no-op: no double flush
+    while eng.health() is not Health.STOPPED:
+        eng.step()
+    assert running.result() == solo(model, cfg, params, prompt_of(6), 0, 6)
+    assert waiting.done and isinstance(waiting.error, RequestPreempted)
+    assert waiting.error.retryable
     assert eng.allocator.num_in_use == 0
 
 

@@ -1,0 +1,56 @@
+"""Fleet layer: health-aware routing, typed-error failover, hot swap.
+
+One :class:`~torchdistx_tpu.serving.engine.Engine` is a single point of
+failure, and upgrading its weights means downtime.  This package fronts
+N engine replicas with a :class:`~.router.FleetRouter` that speaks the
+same ``submit()/tokens()`` streaming API:
+
+* :mod:`.router` — least-estimated-TTFT routing over the per-engine
+  health/TTFT hooks (OVERLOADED avoided, DRAINING/STOPPED excluded),
+  failover of ``retryable`` typed errors to peers under a per-request
+  hop budget with :class:`~torchdistx_tpu.resilience.retry.RetryPolicy`
+  backoff, version-pinned mid-stream replays (token-identical, prefix
+  verified), and typed — never silent — failure when no replica can
+  take a request;
+* :mod:`.hot_swap` — zero-downtime weight upgrade: the next version is
+  recorded with :func:`~torchdistx_tpu.deferred_init.deferred_init`
+  (zero allocation) and materialized into a standby engine while the
+  old version serves, admission flips at a chunk boundary, the old
+  engines drain gracefully and retire — no dropped requests, no stream
+  ever mixing two versions.
+
+Quick start::
+
+    from torchdistx_tpu.fleet import FleetRouter, hot_swap
+
+    router = FleetRouter([make_engine(), make_engine()], version="v1")
+    h = router.submit(prompt_ids, max_new_tokens=128, key=0)
+    for tok in h.tokens():      # streams; fails over transparently
+        print(tok)
+
+    hot_swap(router, make_v2_engine, version="v2")  # zero requests dropped
+
+Telemetry: ``fleet.*`` counters/gauges and the ``fleet.swap`` span
+(docs/observability.md).  Full design: docs/fleet.md.
+"""
+
+from .hot_swap import hot_swap, materialize_standby  # noqa: F401
+from .router import (  # noqa: F401
+    FailoverDiverged,
+    FailoverExhausted,
+    FleetHandle,
+    FleetRouter,
+    NoReplicaAvailable,
+    Replica,
+)
+
+__all__ = [
+    "FailoverDiverged",
+    "FailoverExhausted",
+    "FleetHandle",
+    "FleetRouter",
+    "NoReplicaAvailable",
+    "Replica",
+    "hot_swap",
+    "materialize_standby",
+]

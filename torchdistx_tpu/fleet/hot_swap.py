@@ -1,0 +1,135 @@
+"""Zero-downtime weight hot-swap: deferred-init standby, drain, retire.
+
+The paper's load-bearing feature — deferred-init shard-then-materialize
+— used for what it was built for on the serving side: a model upgrade
+with **zero dropped requests**.  Version v keeps serving while version
+v+1 is *recorded* with zero allocation (:func:`deferred_init` — the
+full architecture is inspectable before a byte is committed) and then
+*materialized* straight into device arrays
+(:func:`materialize_module_jax`, sharded if a mesh plan says so) for a
+standby engine.  The swap choreography:
+
+1. **Build** the standby: :func:`materialize_standby` (or any factory)
+   produces v+1 parameters and an Engine over them.
+2. **Admit** the standby into the router under the new version tag —
+   from this moment new work may land on v+1.
+3. **Shift admission**: the router's gate closes on every v replica
+   (:meth:`~.router.FleetRouter.close_admission`) — new work now routes
+   only to v+1.  This happens between chunks; no stream is interrupted.
+4. **Drain** v gracefully (:meth:`~torchdistx_tpu.serving.engine.Engine
+   .begin_drain` — PR 5's SIGTERM path, minus the signal): queued work
+   flushes with retryable typed errors (the router re-routes it to v+1
+   on its next pull — those requests have yielded nothing, so the
+   version change is invisible), while **in-flight streams finish on
+   their original engine** under the drain deadline.  Tokens from two
+   versions never interleave within one stream.
+5. **Retire**: each drained v engine is removed and ``close()``-d
+   (idempotent on a STOPPED engine), its pages all returned.
+
+A v stream that outlives the drain deadline fails with a *retryable*
+``RequestPreempted`` — but having already yielded tokens it is
+version-pinned, and with every v replica gone the router fails it
+**typed** (:class:`~.router.NoReplicaAvailable`) rather than splicing a
+v+1 continuation onto a v prefix.
+
+Telemetry: the whole swap runs under a ``fleet.swap`` span and bumps
+``fleet.swaps`` on success (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from .. import telemetry as _telemetry
+from ..serving.lifecycle import Health
+from .router import FleetRouter
+
+__all__ = ["hot_swap", "materialize_standby"]
+
+_T_SWAPS = _telemetry.counter("fleet.swaps")
+
+
+def materialize_standby(
+    module_fn: Callable,
+    *args,
+    convert: Optional[Callable] = None,
+    materialize_kwargs: Optional[dict] = None,
+    **kwargs,
+):
+    """Build the next version's parameters while the current one serves.
+
+    ``module_fn(*args, **kwargs)`` is constructed under
+    :func:`~torchdistx_tpu.deferred_init.deferred_init` — every
+    parameter fake, every initializer recorded, zero bytes allocated —
+    then replayed as real ``jax.Array`` leaves by
+    :func:`~torchdistx_tpu.materialize.materialize_module_jax`
+    (``materialize_kwargs`` passes a mesh/plan through for sharded
+    standbys).  ``convert`` maps the flat ``{qualified_name: array}``
+    dict into a family pytree (e.g.
+    :func:`~torchdistx_tpu.models.convert.llama_params_from_hf`).
+
+    Torch imports happen here, lazily: a fleet that never hot-swaps
+    never touches the deferred-init stack.
+    """
+    from .. import deferred_init as _di
+    from ..materialize import materialize_module_jax
+
+    module = _di.deferred_init(module_fn, *args, **kwargs)
+    arrays = materialize_module_jax(module, **(materialize_kwargs or {}))
+    return convert(arrays) if convert is not None else arrays
+
+
+def hot_swap(
+    router: FleetRouter,
+    make_standby: Callable[[], object],
+    *,
+    version: str,
+    retire: Optional[Iterable[int]] = None,
+    max_steps: int = 200_000,
+) -> int:
+    """Upgrade the fleet to ``version`` with zero dropped requests.
+
+    ``make_standby`` builds the v+1 engine (typically over parameters
+    from :func:`materialize_standby`); ``retire`` names the replica ids
+    to drain out (default: every replica whose version differs from
+    ``version``).  Blocks (stepping the retiring engines) until they
+    drain; ``max_steps`` bounds the wait — a stuck drain raises rather
+    than spinning forever.  Returns the new replica's id.
+    """
+    sp = _telemetry.start_span("fleet.swap", version=version)
+    try:
+        standby = make_standby()
+        if retire is None:
+            old = [r for r in router.replicas() if r.version != version]
+        else:
+            retire = set(retire)
+            old = [r for r in router.replicas() if r.rid in retire]
+        new_rid = router.add_replica(standby, version=version)
+        # Admission shifts to v+1 BEFORE the drain starts: from here no
+        # new work lands on v, and the drain's queue flush re-routes
+        # v's waiting requests (which have yielded nothing) to v+1.
+        for rep in old:
+            router.close_admission(rep.rid)
+        for rep in old:
+            rep.engine.begin_drain()
+        steps = 0
+        while any(
+            rep.engine.health() is not Health.STOPPED for rep in old
+        ):
+            for rep in old:
+                if rep.engine.health() is not Health.STOPPED:
+                    rep.engine.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"hot swap to {version!r}: retiring engines did not "
+                    f"drain within {max_steps} steps"
+                )
+        for rep in old:
+            router.remove_replica(rep.rid)  # close() idempotent on STOPPED
+        _T_SWAPS.add()
+        sp.end(n_retired=len(old), new_replica=new_rid, steps=steps)
+        return new_rid
+    except BaseException:
+        sp.cancel()
+        raise

@@ -1,0 +1,551 @@
+"""Health-aware multi-engine router with typed-error failover.
+
+One :class:`~torchdistx_tpu.serving.engine.Engine` is a single point of
+failure and a single admission queue.  :class:`FleetRouter` fronts N
+engine replicas behind the same ``submit()/tokens()`` streaming API and
+makes the typed-error taxonomy of :mod:`torchdistx_tpu.serving.lifecycle`
+*actionable*: a request that fails with ``retryable=True`` anywhere in
+its life — shed by an overloaded replica, flushed by a drain, aborted by
+a crashed/closed engine, beyond a recovery budget — is re-submitted to a
+peer under a per-request **hop budget**, with
+:class:`~torchdistx_tpu.resilience.retry.RetryPolicy` backoff between
+hops.  When no replica can take it, the failure is **typed**
+(:class:`NoReplicaAvailable` / :class:`FailoverExhausted`) — never a
+silent drop, never a hang.
+
+**Routing policy** (least-estimated-TTFT): among replicas with open
+admission, DRAINING/STOPPED are excluded outright, OVERLOADED replicas
+are avoided (used only when nothing healthier exists — their shed is
+retryable, so the failover path covers a wrong guess), and the rest are
+ranked by ``(est_ttft_s, queued+running, replica id)`` — the per-engine
+:meth:`~torchdistx_tpu.serving.engine.Engine.est_ttft_s` hook, NOT the
+process-global ``serve.est_ttft_s`` gauge, which N replicas in one
+process would clobber.
+
+**Failover token parity**: engine output is token-identical to solo
+``generate()`` with the same key, so a replay on a peer reproduces the
+stream from the start.  The fleet handle pins the request key at
+submission, skips the already-yielded prefix of the replacement stream
+(verifying it token-by-token — a divergence fails typed as
+:class:`FailoverDiverged`, never silently), and the consumer's iterator
+continues mid-stream as if nothing happened.  A stream that has already
+yielded tokens is version-pinned: it may only fail over to a replica
+serving the SAME weights version, so tokens from two model versions
+never interleave within one stream (see :mod:`.hot_swap`).
+
+**Replica supervision**: a crashed or :meth:`close`-d replica is
+detected via its health state; :meth:`FleetRouter.poll` (called by every
+:meth:`FleetRouter.step`) reaps STOPPED replicas.  Its queued and live
+work was already failed with retryable typed errors by the engine's own
+close/drain choreography, so each affected fleet handle re-routes itself
+on its next pull.  A replacement can be respawned into the fleet with
+:meth:`FleetRouter.add_replica` at any time.
+
+Telemetry: ``fleet.submitted`` / ``fleet.failovers`` /
+``fleet.hops_exhausted`` counters and the ``fleet.replicas_ready`` gauge
+(docs/observability.md); the hot-swap machinery adds ``fleet.swaps`` and
+the ``fleet.swap`` span (:mod:`.hot_swap`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from ..resilience.retry import RetryPolicy
+from ..serving.lifecycle import (
+    DeadlineExceeded,
+    Health,
+    RequestCancelled,
+    RequestError,
+)
+
+__all__ = [
+    "FailoverDiverged",
+    "FailoverExhausted",
+    "FleetHandle",
+    "FleetRouter",
+    "NoReplicaAvailable",
+    "Replica",
+]
+
+_T_SUBMITTED = _telemetry.counter("fleet.submitted")
+_T_FAILOVERS = _telemetry.counter("fleet.failovers")
+_T_HOPS_EXHAUSTED = _telemetry.counter("fleet.hops_exhausted")
+_G_REPLICAS_READY = _telemetry.gauge("fleet.replicas_ready")
+
+# Health states a replica may be routed to.  DRAINING/STOPPED are
+# excluded outright; OVERLOADED is routable but avoided (last resort).
+_ROUTABLE = (Health.STARTING, Health.READY, Health.OVERLOADED)
+_PREFERRED = (Health.STARTING, Health.READY)
+
+
+class NoReplicaAvailable(RequestError):
+    """No replica can take the request: every candidate is draining,
+    stopped, excluded by a failed hop, or (for a mid-stream failover)
+    serves a different weights version.  Retryable — the fleet may heal
+    (a respawn, a finished swap) and the identical request succeed."""
+
+    retryable = True
+
+
+class FailoverExhausted(RequestError):
+    """The request burned through its per-request hop budget without
+    completing; ``__cause__`` is the last underlying typed failure.
+    Retryable at a higher level — the budget bounds THIS submission."""
+
+    retryable = True
+
+
+class FailoverDiverged(RequestError):
+    """A failover replay's prefix did not match the tokens already
+    yielded to the consumer — the token-parity invariant broke (wrong
+    weights on a same-version peer, or a correctness bug).  NOT
+    retryable: the stream cannot be continued without interleaving two
+    different generations."""
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine in the fleet (router-side bookkeeping)."""
+
+    rid: int
+    engine: Any
+    version: str
+    admitting: bool = True  # router-level admission gate (hot swap)
+
+    def load(self) -> int:
+        """Queued + running requests — the routing tiebreak."""
+        eng = self.engine
+        return len(eng.scheduler) + eng._n_running()
+
+
+class FleetHandle:
+    """Streaming view of one fleet request, across failovers.
+
+    Mirrors :class:`~torchdistx_tpu.serving.scheduler.RequestHandle`
+    (``tokens()`` / ``result()`` / ``cancel()`` / ``done`` / ``error``)
+    but survives the death of the engine serving it: a retryable typed
+    failure re-binds the handle to a peer and the iterator continues
+    where it left off.  ``done``/``error`` reflect what the *consumer*
+    has observed — a handle is done once its stream was pulled to
+    completion or failed terminally.
+    """
+
+    def __init__(
+        self,
+        router: "FleetRouter",
+        prompt,
+        max_new_tokens: int,
+        key,
+        deadline_s: Optional[float],
+        max_hops: int,
+    ):
+        self._router = router
+        self._prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._max_new_tokens = int(max_new_tokens)
+        self._key = key
+        self._deadline = (
+            time.perf_counter() + deadline_s if deadline_s is not None else None
+        )
+        self._max_hops = int(max_hops)
+        self._committed: List[int] = []  # tokens yielded to the consumer
+        self._inner = None  # current engine-side RequestHandle
+        self._cancelled = False
+        self._done = False
+        self.error: Optional[BaseException] = None
+        self.hops = 0  # re-submissions consumed (first binding is free)
+        self.replica_id: Optional[int] = None
+        self.version: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def cancel(self) -> bool:
+        """Request cancellation (forwarded to the bound engine).  A
+        cancelled request never fails over — the resulting
+        ``RequestCancelled`` is the client's own doing.  Returns False
+        (no-op) once the stream already finished."""
+        if self._done:
+            return False
+        self._cancelled = True
+        if self._inner is not None:
+            self._inner.cancel()
+        return True
+
+    # ------------------------------------------------------------------
+    # Binding / failover
+
+    def _fail(self, error: BaseException) -> None:
+        self.error = error
+        self._done = True
+
+    def _remaining_deadline_s(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        remaining = self._deadline - time.perf_counter()
+        if remaining <= 0:
+            err = DeadlineExceeded(
+                "request deadline expired while re-routing "
+                f"(after {self.hops} hop(s))"
+            )
+            self._fail(err)
+            raise err
+        return remaining
+
+    def _bind(self, cause: Optional[BaseException] = None) -> None:
+        """Pick a replica and submit there; synchronous typed-retryable
+        rejections (shed, draining) try the next candidate.  Every
+        re-submission — whether after a mid-stream failure (``cause``)
+        or a rejected hop — consumes the hop budget.  Raises typed
+        (:class:`FailoverExhausted` / :class:`NoReplicaAvailable` /
+        the non-retryable cause) when the request cannot be placed."""
+        excluded = set() if self.replica_id is None else {self.replica_id}
+        # A stream that already yielded tokens must finish on the SAME
+        # weights version — never interleave two models in one stream.
+        version = self.version if self._committed else None
+        retry = self._router.retry
+        while True:
+            if cause is not None:
+                self.hops += 1
+                if self.hops > self._max_hops:
+                    _T_HOPS_EXHAUSTED.add()
+                    err = FailoverExhausted(
+                        f"hop budget ({self._max_hops}) exhausted; "
+                        f"last failure: {cause!r}"
+                    )
+                    err.__cause__ = cause
+                    self._fail(err)
+                    raise err
+                time.sleep(retry.delay(self.hops - 1))
+            rep = self._router._pick(exclude=excluded, version=version)
+            if rep is None and excluded and cause is not None:
+                # Every candidate was excluded by a failed attempt in
+                # THIS binding.  Exclusion only means "not again without
+                # backoff" — the backoff just slept, the replica may
+                # have recovered (a shed queue drains, an overload
+                # clears), and the hop budget still bounds the loop: so
+                # stop shunning the pool and try it again rather than
+                # failing a single-replica fleet on its first hiccup.
+                excluded = set()
+                rep = self._router._pick(exclude=excluded, version=version)
+            if rep is None:
+                err = NoReplicaAvailable(
+                    "no replica can take the request"
+                    + (f" (version-pinned to {version!r})" if version else "")
+                    + f" after {self.hops} hop(s)"
+                )
+                err.__cause__ = cause
+                self._fail(err)
+                raise err
+            try:
+                self._inner = rep.engine.submit(
+                    self._prompt,
+                    max_new_tokens=self._max_new_tokens,
+                    key=self._key,
+                    deadline_s=self._remaining_deadline_s(),
+                )
+            except RequestError as err:
+                if not retry.is_retryable(err):
+                    self._fail(err)
+                    raise
+                excluded.add(rep.rid)
+                cause = err
+                continue
+            self.replica_id = rep.rid
+            self.version = rep.version
+            if cause is not None:
+                _T_FAILOVERS.add()
+            return
+
+    # ------------------------------------------------------------------
+    # Streaming
+
+    def tokens(self) -> Iterator[int]:
+        """Yield tokens as they are produced, driving the bound engine —
+        and re-binding to a peer when it fails retryably.  The replay on
+        the peer is token-identical (same key, same ``fold_in``
+        schedule), so the already-yielded prefix is verified and
+        skipped; the iterator continues mid-stream.  Raises the
+        request's typed error when it fails terminally."""
+        while True:
+            if self._done:
+                if self.error is not None:
+                    raise self.error
+                return
+            inner = self._inner
+            n_skip = len(self._committed)
+            i = 0
+            try:
+                for tok in inner.tokens():
+                    i += 1
+                    if i <= n_skip:
+                        if tok != self._committed[i - 1]:
+                            inner.cancel()
+                            err = FailoverDiverged(
+                                f"failover replay diverged at token {i}: "
+                                f"replayed {tok}, committed "
+                                f"{self._committed[i - 1]} (replica "
+                                f"{self.replica_id}, version {self.version})"
+                            )
+                            self._fail(err)
+                            raise err
+                        continue
+                    self._committed.append(tok)
+                    yield tok
+                if i < n_skip:
+                    # The replay finished SHORTER than the prefix already
+                    # yielded (early EOS under different weights): as
+                    # much a parity break as a mismatched token — a
+                    # "clean" completion here would silently truncate.
+                    err = FailoverDiverged(
+                        f"failover replay ended after {i} token(s), "
+                        f"shorter than the {n_skip} already yielded "
+                        f"(replica {self.replica_id}, version "
+                        f"{self.version})"
+                    )
+                    self._fail(err)
+                    raise err
+                self._done = True
+                return
+            except RequestError as err:
+                if err is self.error:
+                    raise  # our own terminal error (diverged / deadline)
+                if self._cancelled:
+                    # The client's cancel may race a drain/close on the
+                    # bound engine: whichever typed error the engine
+                    # reported, the stream ended because the CLIENT
+                    # cancelled — surface that, and never fail over.
+                    if not isinstance(err, RequestCancelled):
+                        cancelled = RequestCancelled(
+                            "request cancelled by the client (engine "
+                            f"reported {type(err).__name__})"
+                        )
+                        cancelled.__cause__ = err
+                        err = cancelled
+                    self._fail(err)
+                    raise err
+                if not self._router.retry.is_retryable(err):
+                    self._fail(err)
+                    raise
+                self._bind(cause=err)  # raises typed when impossible
+
+    def result(self) -> List[int]:
+        """Block (by streaming) until done; returns all tokens — across
+        however many replicas it took."""
+        for _ in self.tokens():
+            pass
+        return list(self._committed)
+
+
+class FleetRouter:
+    """Front N engine replicas with one streaming submit/tokens API.
+
+    Parameters
+    ----------
+    engines : initial replicas, all registered under ``version``.
+    version : weights-version tag of the initial replicas (hot swaps
+        introduce new tags; mid-stream failover is version-pinned).
+    max_hops : per-request re-submission budget (failovers + rejected
+        placement attempts); exhaustion fails typed, never silently.
+    retry : :class:`~torchdistx_tpu.resilience.retry.RetryPolicy` whose
+        ``is_retryable`` classifies failures (honoring the
+        ``RequestError.retryable`` contract) and whose ``delay``
+        schedule paces the hops.  Default: 5 ms base, 250 ms cap.
+
+    Single-threaded like the engines it fronts: handles drive their
+    bound engine; :meth:`step` advances every live replica (and reaps
+    stopped ones) for drain/idle progress.
+    """
+
+    def __init__(
+        self,
+        engines=(),
+        *,
+        version: str = "v0",
+        max_hops: int = 3,
+        retry: Optional[RetryPolicy] = None,
+    ):
+        if max_hops < 0:
+            raise ValueError("max_hops must be >= 0")
+        self.max_hops = max_hops
+        self.retry = retry or RetryPolicy(
+            max_attempts=max_hops + 1, base_delay_s=0.005, max_delay_s=0.25
+        )
+        self._replicas: Dict[int, Replica] = {}
+        self._next_rid = 0
+        self._next_key = 0
+        for eng in engines:
+            self.add_replica(eng, version=version)
+
+    # ------------------------------------------------------------------
+    # Fleet membership
+
+    def add_replica(self, engine, *, version: str = "v0") -> int:
+        """Register an engine (a fresh spawn, a respawn, or a hot-swap
+        standby); returns its replica id."""
+        rid = self._next_rid
+        self._next_rid += 1
+        self._replicas[rid] = Replica(rid, engine, version)
+        self._update_ready_gauge()
+        return rid
+
+    def remove_replica(self, rid: int, *, close: bool = True) -> None:
+        """Drop a replica from the fleet; by default also ``close()`` its
+        engine (idempotent — a drained/crashed engine is already
+        STOPPED, and close() fails any straggling work retryably so the
+        affected handles re-route)."""
+        rep = self._replicas.pop(rid, None)
+        if rep is not None and close:
+            rep.engine.close()
+        self._update_ready_gauge()
+
+    def close_admission(self, rid: int) -> None:
+        """Stop routing NEW work to a replica (hot swap: admission
+        shifts to the standby before the old engine drains).  In-flight
+        and queued work on the replica is untouched."""
+        self._replicas[rid].admitting = False
+        self._update_ready_gauge()
+
+    def replicas(self) -> List[Replica]:
+        """Snapshot of the fleet membership (routing order)."""
+        return [self._replicas[rid] for rid in sorted(self._replicas)]
+
+    def poll(self) -> List[int]:
+        """Reap replicas whose engine reached STOPPED (crashed, closed,
+        or drained out).  Their queued/live work already failed with
+        retryable typed errors, so the affected handles re-route on
+        their next pull.  Returns the reaped replica ids."""
+        dead = [
+            rid
+            for rid, rep in self._replicas.items()
+            if rep.engine.health() is Health.STOPPED
+        ]
+        for rid in dead:
+            self.remove_replica(rid, close=False)
+        return dead
+
+    def close(self) -> None:
+        """Retire the whole fleet NOW: every replica engine is closed
+        (outstanding work fails retryable-typed) and dropped."""
+        for rid in list(self._replicas):
+            self.remove_replica(rid, close=True)
+
+    # ------------------------------------------------------------------
+    # Routing
+
+    def _pick(
+        self,
+        exclude=frozenset(),
+        version: Optional[str] = None,
+    ) -> Optional[Replica]:
+        """Least-estimated-TTFT among routable replicas.  READY (and
+        STARTING) replicas are preferred; OVERLOADED ones serve only as
+        a last resort; DRAINING/STOPPED never route."""
+        candidates = [
+            rep
+            for rep in self._replicas.values()
+            if rep.admitting
+            and rep.rid not in exclude
+            and (version is None or rep.version == version)
+            and rep.engine.health() in _ROUTABLE
+        ]
+        self._update_ready_gauge()
+        if not candidates:
+            return None
+        preferred = [
+            rep for rep in candidates if rep.engine.health() in _PREFERRED
+        ]
+        pool = preferred or candidates
+        return min(
+            pool, key=lambda r: (r.engine.est_ttft_s(), r.load(), r.rid)
+        )
+
+    def _update_ready_gauge(self) -> None:
+        _G_REPLICAS_READY.set(
+            sum(
+                rep.admitting and rep.engine.health() in _PREFERRED
+                for rep in self._replicas.values()
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # The fleet API
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: int,
+        key: Any = None,
+        deadline_s: Optional[float] = None,
+        max_hops: Optional[int] = None,
+    ) -> FleetHandle:
+        """Route a request to the best replica; returns its streaming
+        :class:`FleetHandle`.
+
+        ``key`` is pinned HERE (defaulting to a fleet-wide counter, not
+        any engine's request id) so every failover replay of the request
+        samples identically on any replica.  ``deadline_s`` is a fleet-
+        level wall-clock budget: each hop re-submits with the remaining
+        time.  Raises :class:`NoReplicaAvailable` (typed, retryable)
+        when no replica can take it, and plain ``ValueError`` for
+        requests that could never run anywhere (engine validation)."""
+        if key is None:
+            key = self._next_key
+            self._next_key += 1
+        handle = FleetHandle(
+            self,
+            prompt,
+            max_new_tokens,
+            key,
+            deadline_s,
+            self.max_hops if max_hops is None else max_hops,
+        )
+        _T_SUBMITTED.add()
+        try:
+            handle._bind()
+        except DeadlineExceeded as err:
+            # The deadline expired before the request could even be
+            # placed (the engine analog: expiring in queue).  The
+            # handle carries the typed error; the pull raises it —
+            # submit() itself only raises for requests that could
+            # never run (ValueError) or a fleet that cannot take them.
+            if err is not handle.error:
+                raise
+        return handle
+
+    def step(self) -> None:
+        """Advance every live replica one tick and reap stopped ones.
+        Handles drive their own engine while streaming; step() exists
+        for drain progress and idle upkeep (a draining replica with no
+        consumer pulling it still has to finish its in-flight work)."""
+        for rep in self.replicas():
+            if rep.engine.health() is not Health.STOPPED:
+                rep.engine.step()
+        self.poll()
+
+    def stats(self) -> dict:
+        """Fleet-level introspection: per-replica health/load plus the
+        failover counters."""
+        return {
+            "replicas": [
+                {
+                    "rid": rep.rid,
+                    "version": rep.version,
+                    "admitting": rep.admitting,
+                    "health": rep.engine.health().value,
+                    "est_ttft_s": round(rep.engine.est_ttft_s(), 4),
+                    "load": rep.load(),
+                }
+                for rep in self.replicas()
+            ],
+            "submitted": _T_SUBMITTED.value,
+            "failovers": _T_FAILOVERS.value,
+            "hops_exhausted": _T_HOPS_EXHAUSTED.value,
+        }

@@ -8,12 +8,19 @@ after ``max_attempts`` tries or a wall-clock ``deadline_s`` — raising
 :class:`RetriesExhausted` chained to the last underlying error so the
 root cause stays in the traceback.
 
-Classification is two-layered: an ``isinstance`` check against
-``retryable`` (default ``OSError``, which covers ``ConnectionError`` and
-``TimeoutError``) plus a *name* match against ``retryable_names`` for
-backend exception types this package must not import (grpc/GCS/orbax
-transport errors surface with names like ``Unavailable`` or
-``DeadlineExceeded`` but live in optional dependencies).
+Classification is three-layered, most-specific first: an explicit
+boolean ``retryable`` attribute on the exception is authoritative (the
+:class:`~torchdistx_tpu.serving.lifecycle.RequestError` contract — the
+raiser knows better than any heuristic, so the serving fleet router,
+checkpoint IO, and data IO all share this one classification path);
+then an ``isinstance`` check against ``retryable`` (default ``OSError``,
+which covers ``ConnectionError`` and ``TimeoutError``); then a *name*
+match against ``retryable_names`` for backend exception types this
+package must not import (grpc/GCS/orbax transport errors surface with
+names like ``Unavailable`` or ``DeadlineExceeded`` but live in optional
+dependencies).  The attribute layer is what keeps a serving
+``DeadlineExceeded`` (``retryable=False``) from colliding with grpc's
+transient status of the same name.
 
 Every granted retry can bump a telemetry counter supplied by the call
 site (``ckpt.retries``, ``data.retries``), so recovery is visible in
@@ -79,6 +86,12 @@ class RetryPolicy:
             raise ValueError("jitter must be in [0, 1]")
 
     def is_retryable(self, exc: BaseException) -> bool:
+        # An explicit boolean `retryable` attribute wins outright: the
+        # raiser's own classification (the RequestError contract) must
+        # not be overridden by an isinstance or name coincidence.
+        flag = getattr(exc, "retryable", None)
+        if isinstance(flag, bool):
+            return flag
         if isinstance(exc, self.retryable):
             return True
         return type(exc).__name__ in self.retryable_names

@@ -40,6 +40,10 @@ deadline, the remainder fails with a retryable typed error.  Telemetry:
 ``serve.*`` spans/counters/gauges (docs/observability.md); fault sites
 ``serve.admit`` / ``serve.prefill`` / ``serve.step`` / ``serve.recover``
 (docs/resilience.md).  Full design: docs/serving.md.
+
+One engine is still a single point of failure: :mod:`torchdistx_tpu
+.fleet` fronts N of them with health-aware routing, typed-error
+failover, and zero-downtime weight hot swap (docs/fleet.md).
 """
 
 from .blocks import BlockAllocator, blocks_needed  # noqa: F401

@@ -448,6 +448,28 @@ class Engine:
         """Current :class:`.lifecycle.Health` state."""
         return self._health
 
+    def est_ttft_s(self) -> float:
+        """Estimated wait-for-prefill of a request arriving now.
+
+        Router hook (:mod:`torchdistx_tpu.fleet`): the PER-ENGINE value
+        behind the process-global ``serve.est_ttft_s`` gauge — a fleet
+        of replicas in one process shares that gauge, so anything
+        load-balancing across engines must read this instead."""
+        return self.detector.est_ttft_s(
+            len(self.scheduler), self.max_prefills_per_tick
+        )
+
+    def begin_drain(self) -> None:
+        """Start a graceful drain NOW, without a preemption signal.
+
+        Router/lifecycle hook: the same path a SIGTERM takes — admission
+        closes, the waiting queue fails with retryable typed errors, and
+        subsequent :meth:`step` calls finish in-flight work under
+        ``drain_deadline_s`` before the engine lands STOPPED.  No-op on
+        an engine already DRAINING or STOPPED."""
+        if self._health not in (Health.DRAINING, Health.STOPPED):
+            self._begin_drain()
+
     def _set_health(self, health: Health) -> None:
         if health is not self._health:
             self._health = health
@@ -483,15 +505,23 @@ class Engine:
         ):
             self._set_health(Health.READY)
         self.detector.observe_tick(time.perf_counter() - t0)
-        if self.detector.enabled:
-            _G_EST_TTFT.set(
-                round(
-                    self.detector.est_ttft_s(
-                        len(self.scheduler), self.max_prefills_per_tick
-                    ),
-                    4,
+        # A tick that completed the drain must not re-write the routing
+        # gauges _finish_drain just cleared — a stopped engine leaves no
+        # stale readings behind.  A live engine re-asserts BOTH every
+        # tick (not just on transitions): in a fleet, a peer reaching
+        # STOPPED clears the process-global gauges, and the next live
+        # replica's tick is what restores them.
+        if self._health is not Health.STOPPED:
+            _G_HEALTH.set(self._health.value)
+            if self.detector.enabled:
+                _G_EST_TTFT.set(
+                    round(
+                        self.detector.est_ttft_s(
+                            len(self.scheduler), self.max_prefills_per_tick
+                        ),
+                        4,
+                    )
                 )
-            )
         _G_RUNNING.set(self._n_running())
 
     # ------------------------------------------------------------------
@@ -597,6 +627,12 @@ class Engine:
             self._drain_sp.end(timed_out=timed_out)
             self._drain_sp = None
         self._set_health(Health.STOPPED)
+        # The serving gauges are process-global: a stopped engine must
+        # not leave its last readings behind for a router (or an
+        # operator tailing the trace) to load-balance on — clear them;
+        # the next live replica's tick re-sets both.
+        _G_HEALTH.set(None)
+        _G_EST_TTFT.set(None)
         if self._handle_preemption and not self._handlers_preexisting:
             _preemption.uninstall()
 
