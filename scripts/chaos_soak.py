@@ -12,6 +12,15 @@ tiny deadlines and client cancels.  Every request must either complete
 RequestError; the drive loop is bounded (a hang fails), the allocator
 must end with zero pages owned, and the engine must be back to READY.
 
+Phase 1.5 — prefix soak (ISSUE 7 acceptance gate): 80% of a second
+request wave shares one system prompt, served by a prefix-cache +
+chunked-prefill engine (``prefix_cache=True``, ``prefill_chunk=8``)
+under injected ``serve.prefill`` faults, deadlines, and cancels.  Every
+request must stay token-identical (page sharing and copy-on-write are
+invisible in the stream) or fail typed, and at drain the allocator must
+hold exactly the index's pages with every refcount 1 — zero leaked
+pages, zero stale-refcount pages.
+
 Phase 2 — drain: under live load, a real SIGTERM goes through the real
 handler chain.  The engine must reach STOPPED within the drain deadline,
 finishing in-flight work or failing it with a retryable typed error —
@@ -199,6 +208,95 @@ def main() -> int:
         f"(seed={SEED}, n={N_REQUESTS})"
     )
 
+    # ---------------- Phase 1.5: prefix-heavy soak ----------------
+    # The production traffic shape: 80% of requests share one system
+    # prompt, served by a prefix-cache + chunked-prefill engine under
+    # injected serve.prefill faults, deadlines, and cancels.  The gate:
+    # token identity survives page sharing and CoW, and at drain the
+    # allocator holds EXACTLY the index's pages, every refcount 1 — zero
+    # leaked pages, zero stale refcounts.
+    faults.reset("")
+    eng_mod._decode_chunk = real_decode
+    pspecs = []
+    for step in rng.integers(1, N_REQUESTS, size=8):
+        pspecs.append(f"serve.prefill:{int(step)}:{rng.choice(['io', 'nan'])}")
+    faults.reset(",".join(sorted(set(pspecs))))
+    engp = Engine(
+        params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+        block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+        prefill_chunk=8, prefix_cache=True,
+        max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+    )
+    system = rng.integers(0, cfg.vocab_size, size=16).astype(np.int32)
+    preqs = []
+    for i in range(N_REQUESTS):
+        tail = rng.integers(
+            0, cfg.vocab_size, size=int(rng.integers(2, 24))
+        ).astype(np.int32)
+        prompt = (
+            np.concatenate([system, tail]) if rng.random() < 0.8 else tail
+        )
+        mnt = int(rng.choice(budgets))
+        deadline = None if rng.random() > 0.05 else 1e-6
+        h = engp.submit(
+            prompt, max_new_tokens=mnt, key=2000 + i, deadline_s=deadline
+        )
+        if rng.random() < 0.05:
+            h.cancel()
+        preqs.append((prompt, mnt, 2000 + i, h))
+
+    for _ in range(MAX_STEPS):
+        if not (len(engp.scheduler) or engp._n_running()):
+            break
+        engp.step()
+    else:
+        return fail(f"prefix soak did not drain within {MAX_STEPS} steps")
+
+    n_ok = n_typed = 0
+    for prompt, mnt, key, h in preqs:
+        if not h.done:
+            return fail(f"prefix request {key} neither finished nor failed")
+        if h.error is not None:
+            if not isinstance(h.error, RequestError):
+                return fail(f"prefix request {key} failed UNTYPED: {h.error!r}")
+            n_typed += 1
+        else:
+            if h.result() != solo(prompt, key, mnt):
+                return fail(
+                    f"prefix request {key} diverged from solo generate()"
+                )
+            n_ok += 1
+    st = engp.stats()
+    if st["prefix_hits"] < N_REQUESTS // 4:
+        return fail(
+            f"prefix soak hit rate implausibly low ({st['prefix_hits']})"
+        )
+    # Zero leaked pages: everything still owned belongs to the index...
+    if engp.allocator.num_in_use != len(engp.prefix):
+        return fail(
+            f"prefix soak leaked pages: {engp.allocator.num_in_use} in use "
+            f"vs {len(engp.prefix)} indexed"
+        )
+    # ...and zero refcount drift: every indexed page rc exactly 1.
+    drift = engp.prefix.check(engp.allocator)
+    if drift is not None:
+        return fail(f"prefix soak refcount drift: {drift}")
+    stale = [
+        p for p in list(engp.prefix._pages.values())
+        if engp.allocator.refcount(p) != 1
+    ]
+    if stale:
+        return fail(f"prefix soak stale refcounts on pages {stale}")
+    engp.prefix.release(engp.allocator)
+    if engp.allocator.num_in_use != 0:
+        return fail("prefix index release left pages owned")
+    print(
+        f"chaos_soak: prefix soak OK — {n_ok} token-identical, {n_typed} "
+        f"typed failures, hits={st['prefix_hits']}, "
+        f"hit_tokens={st['prefix_hit_tokens']}, cow={st['cow_copies']}, "
+        f"evictions={st['prefix_evictions']}"
+    )
+
     # ---------------- Phase 2: SIGTERM drain under load ----------------
     faults.reset("")
     eng_mod._decode_chunk = real_decode
@@ -252,6 +350,11 @@ def main() -> int:
         return fail(
             "trace shows no serve.recoveries "
             f"({ {k: v for k, v in counters.items() if k.startswith('serve')} })"
+        )
+    if counters.get("serve.prefix_hits", 0) < 1:
+        return fail(
+            "trace shows no serve.prefix_hits — the prefix-heavy phase "
+            "left no mark"
         )
     print(
         "chaos_soak: trace OK — recoveries="

@@ -176,6 +176,49 @@ def test_midstream_failover_token_identical(family, temperature, top_k):
     assert eng_b.allocator.num_in_use == 0
 
 
+def test_failover_replays_prefix_cached_stream(family):
+    """A stream admitted THROUGH the prefix cache (its prompt's prefill
+    skipped via shared pages) must fail over like any other: the peer —
+    whose own cache has never seen the prefix — re-prefills from the
+    pinned key and continues token-identically.  Prefix caching is a
+    per-engine acceleration; it must never leak into the stream
+    contract."""
+    model, cfg, params = family
+    kw = dict(temperature=0.8, top_k=8, eos_id=EOS, prefix_cache=True)
+    eng_a = make_engine(family, **kw)
+    eng_b = make_engine(family, **kw)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    # Pin least-TTFT routing onto A (B reads slow) so the warmer and the
+    # victim land on the SAME replica's prefix index.
+    eng_b.detector.observe_tick(5.0)
+    # Warm A's prefix index with the shared system prompt.
+    warm = router.submit(prompt_of(16), max_new_tokens=2, key=7)
+    assert warm.replica_id == 0
+    assert len(warm.result()) == 2
+    assert eng_a.stats()["prefix_cached_pages"] >= 2
+    # The victim stream extends the cached prefix: admission maps shared
+    # pages instead of prefilling them.
+    victim_prompt = np.concatenate([prompt_of(16), prompt_of(4, base=90)])
+    hits_before = eng_a.stats()["prefix_hits"]
+    h = router.submit(victim_prompt, max_new_tokens=10, key=8)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    assert eng_a.stats()["prefix_hits"] == hits_before + 1
+    eng_a.close()  # the replica (and its whole prefix cache) dies
+    rest = list(g)
+    assert first + rest == solo(
+        model, cfg, params, victim_prompt, 8, 10, eos=EOS,
+        temperature=0.8, top_k=8,
+    )
+    assert h.replica_id == 1 and h.hops == 1
+    assert eng_a.allocator.num_in_use == 0
+    # B served the replay cold and cached the replayed prompt's pages.
+    assert eng_b.stats()["prefix_cached_pages"] >= 2
+    eng_b.close()
+    assert eng_b.allocator.num_in_use == 0
+
+
 def test_queued_work_reroutes_on_drain(family):
     """begin_drain() flushes a replica's queue with retryable errors;
     the router re-places that work on a peer — nothing is dropped."""

@@ -126,6 +126,57 @@ def test_blocks_needed():
     assert blocks_needed(9, 8) == 2
 
 
+def test_allocator_refcounts_share_free():
+    """Prefix-sharing refcounts: share() adds references, free() drops
+    one, the page only returns to the free list at zero — and the
+    physical accounting (num_in_use, utilization) counts a shared page
+    ONCE."""
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    got = a.alloc(2)
+    assert [a.refcount(b) for b in got] == [1, 1]
+    a.share(got)  # the index's reference
+    a.share([got[0]])  # and a second request's, on one of them
+    assert a.refcount(got[0]) == 3 and a.refcount(got[1]) == 2
+    # Physical: still 2 pages of HBM, not 5.
+    assert a.num_in_use == 2
+    assert a.utilization() == pytest.approx(2 / 7)
+    a.free(got)  # first owners walk away
+    assert a.num_in_use == 2  # pages survive: the index still holds them
+    a.free([got[0]])  # second request done
+    a.free(got)  # the index lets go of both
+    assert a.num_in_use == 0 and a.num_free == 7
+    assert a.refcount(got[0]) == 0
+
+
+def test_allocator_share_and_free_invariants():
+    """Stray share (page not in use), double free past zero, and reset
+    all behave: raise, raise, forget."""
+    a = BlockAllocator(num_blocks=8, block_size=4)
+    with pytest.raises(RuntimeError, match="stray share"):
+        a.share([3])
+    with pytest.raises(RuntimeError, match="stray share"):
+        a.share([0])  # the trash page is never shareable
+    got = a.alloc(1)
+    a.share(got)
+    a.free(got)
+    a.free(got)
+    with pytest.raises(RuntimeError, match="not in use"):
+        a.free(got)  # refcount already hit zero: double free
+    # A failed multi-page free must not half-apply: validation runs
+    # before any reference moves, wherever the bad page sits.
+    got2 = a.alloc(1)
+    with pytest.raises(RuntimeError, match="not in use"):
+        a.free([got2[0], 5])  # page 5 was never allocated
+    assert a.refcount(got2[0]) == 1  # untouched by the failed call
+    with pytest.raises(RuntimeError, match="not in use"):
+        a.free([got2[0], got2[0]])  # two drops of a single reference
+    assert a.refcount(got2[0]) == 1
+    a.share(got2)
+    a.reset()
+    assert a.num_in_use == 0 and a.num_free == a.capacity
+    assert a.refcount(got2[0]) == 0
+
+
 # ---------------------------------------------------------------------------
 # Paged attention + prompt scatter
 
@@ -323,15 +374,16 @@ def test_engine_failed_prefill_frees_reservation_and_retries(monkeypatch):
     cfg = llama.llama_test()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
-    real = eng_mod._prefill
+    real = eng_mod._prefill_chunk_last
 
     def boom(*a, **k):
         raise RuntimeError("injected prefill failure")
 
     # Persistent failure: every retry frees the reservation, and the
     # budget (max_recoveries=2 → 3 attempts) ends in a typed failure,
-    # not a raise out of step() and not a hang.
-    monkeypatch.setattr(eng_mod, "_prefill", boom)
+    # not a raise out of step() and not a hang.  (A short prompt is one
+    # chunk, so _prefill_chunk_last is the whole prefill dispatch.)
+    monkeypatch.setattr(eng_mod, "_prefill_chunk_last", boom)
     h = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=0)
     for _ in range(3):
         eng.step()
@@ -351,7 +403,7 @@ def test_engine_failed_prefill_frees_reservation_and_retries(monkeypatch):
             raise RuntimeError("injected prefill failure")
         return real(*a, **k)
 
-    monkeypatch.setattr(eng_mod, "_prefill", boom_once)
+    monkeypatch.setattr(eng_mod, "_prefill_chunk_last", boom_once)
     h2 = eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=7)
     eng.drain()
     assert h2.result() == solo(
@@ -444,6 +496,213 @@ def test_engine_fault_fatal_propagates():
             eng.drain()
     finally:
         faults.reset("")
+
+
+# ---------------------------------------------------------------------------
+# Prefix caching + chunked prefill (ISSUE 7)
+
+
+def shared_prefix_requests(cfg, sys_len=16, tail_len=5, n=4):
+    """n prompts sharing a sys_len-token system prompt, distinct tails."""
+    rng = np.random.default_rng(7)
+    system = rng.integers(0, cfg.vocab_size, size=sys_len).astype(np.int32)
+    return [
+        np.concatenate(
+            [system, rng.integers(0, cfg.vocab_size, size=tail_len).astype(np.int32)]
+        )
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_prefix_cache_token_identical(sampled):
+    """Requests sharing a system prompt: cache-on output ≡ cache-off
+    output ≡ solo generate (greedy AND sampled), the shared pages hit,
+    and after every request finishes the only pages still owned are the
+    index's own (refcount exactly 1 — zero drift)."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    sample_kw = (
+        dict(temperature=0.8, top_k=20) if sampled else {}
+    )
+    prompts = shared_prefix_requests(cfg)
+    results = {}
+    for cache_on in (False, True):
+        eng = Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS,
+            prefix_cache=cache_on, **sample_kw, **ENGINE_KW,
+        )
+        handles = [
+            eng.submit(p, max_new_tokens=9, key=200 + i)
+            for i, p in enumerate(prompts)
+        ]
+        eng.drain()
+        results[cache_on] = [h.result() for h in handles]
+        if cache_on:
+            st = eng.stats()
+            # 16-token system prompt, 8-token pages: 2 shared pages per
+            # follow-up request.
+            assert st["prefix_hits"] >= len(prompts) - 1, st
+            assert st["prefix_hit_tokens"] >= (len(prompts) - 1) * 16, st
+            # Zero refcount drift: every surviving page belongs to the
+            # index alone, and releasing the cache releases everything.
+            assert eng.prefix.check(eng.allocator) is None
+            assert eng.allocator.num_in_use == len(eng.prefix)
+            eng.prefix.release(eng.allocator)
+        assert eng.allocator.num_in_use == 0
+    for i, p in enumerate(prompts):
+        ref = solo(
+            llama, cfg, params, p, 200 + i, 9, eos=EOS, **sample_kw
+        )
+        assert results[False][i] == ref, f"cache-off diverged on {i}"
+        assert results[True][i] == ref, f"cache-on diverged on {i}"
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_chunked_prefill_token_identical(sampled):
+    """A prompt longer than prefill_chunk splits across ticks; chunked
+    output ≡ unchunked output ≡ solo generate, greedy and sampled."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    sample_kw = dict(temperature=0.8, top_k=20) if sampled else {}
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=n).astype(np.int32)
+        for n in (19, 32, 7)
+    ]
+    results = {}
+    for chunk in (4, 512):  # 4 → up to 8 chunks; 512 → single chunk
+        eng = Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS, prefill_chunk=chunk,
+            min_prefill_bucket=4, **sample_kw, **ENGINE_KW,
+        )
+        handles = [
+            eng.submit(p, max_new_tokens=9, key=300 + i)
+            for i, p in enumerate(prompts)
+        ]
+        eng.drain()
+        results[chunk] = [h.result() for h in handles]
+        assert eng.allocator.num_in_use == 0
+    for i, p in enumerate(prompts):
+        ref = solo(llama, cfg, params, p, 300 + i, 9, eos=EOS, **sample_kw)
+        assert results[4][i] == ref, f"chunked diverged on prompt {i}"
+        assert results[512][i] == ref, f"unchunked diverged on prompt {i}"
+
+
+def test_chunked_prefill_interleaves_decode():
+    """A long prompt admitted mid-load must not freeze the running
+    stream: with prefill_chunk=4, every tick of the long prefill still
+    runs a decode chunk — the running slot keeps emitting between
+    admission and the long prompt's first token."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(
+        params, model=llama, cfg=cfg, num_slots=2, block_size=8,
+        max_model_len=64, decode_chunk=2, prefill_chunk=4,
+        min_prefill_bucket=4,
+    )
+    running = eng.submit(np.arange(1, 7, dtype=np.int32), max_new_tokens=40, key=0)
+    eng.step()  # running stream admitted and decoding
+    emitted_before = len(running._tokens)
+    rng = np.random.default_rng(3)
+    long = eng.submit(
+        rng.integers(0, cfg.vocab_size, size=32).astype(np.int32),
+        max_new_tokens=4, key=1,
+    )
+    progress = []  # running stream's token count at each prefill tick
+    while long.ttft_s is None:
+        eng.step()
+        progress.append(len(running._tokens))
+    # The 32-token prompt took several chunked ticks...
+    assert len(progress) >= 8, f"expected >= 8 chunk ticks, got {len(progress)}"
+    # ...and the running stream advanced on EVERY one of them (2
+    # tokens/tick: decode never skipped a beat while the prefill ran).
+    assert progress[0] > emitted_before
+    assert all(b > a for a, b in zip(progress, progress[1:])), progress
+    eng.drain()
+    assert running.result() == solo(
+        llama, cfg, params, np.arange(1, 7, dtype=np.int32), 0, 40
+    )
+    assert eng.allocator.num_in_use == 0
+
+
+def test_cow_divergence_mid_page():
+    """Copy-on-write: a block-aligned prompt fully served from cache
+    still needs its last token's logits, so the final shared page is
+    privatized before the recompute writes mid-page into it.  Two
+    sampled streams diverging from the same cached prefix must each
+    match their solo run — and the original's cached pages survive
+    untouched (a third request still hits them)."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    before = telemetry.counter("serve.cow_copies").value
+    eng = Engine(
+        params, model=llama, cfg=cfg, eos_id=EOS, prefix_cache=True,
+        temperature=0.8, top_k=20, **ENGINE_KW,
+    )
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, cfg.vocab_size, size=16).astype(np.int32)  # 2 pages exactly
+    ha = eng.submit(prompt, max_new_tokens=8, key=400)
+    eng.drain()
+    # B and C: full-prompt hits on A's pages, then divergent sampling.
+    hb = eng.submit(prompt, max_new_tokens=8, key=401)
+    hc = eng.submit(prompt, max_new_tokens=8, key=402)
+    eng.drain()
+    st = eng.stats()
+    assert st["prefix_hits"] == 2 and st["cow_copies"] == 2, st
+    assert telemetry.counter("serve.cow_copies").value == before + 2
+    for h, key in ((ha, 400), (hb, 401), (hc, 402)):
+        assert h.result() == solo(
+            llama, cfg, params, prompt, key, 8, eos=EOS,
+            temperature=0.8, top_k=20,
+        ), f"key {key} diverged"
+    # The shared pages were never scribbled on: a divergent-tail prompt
+    # still matches only the intact first page.
+    tail = np.concatenate([prompt[:12], prompt[:9]]).astype(np.int32)
+    hd = eng.submit(tail, max_new_tokens=8, key=403)
+    eng.drain()
+    assert hd.result() == solo(
+        llama, cfg, params, tail, 403, 8, eos=EOS,
+        temperature=0.8, top_k=20,
+    )
+    assert eng.stats()["prefix_hits"] == 3  # page 0 hit; divergence mid-page 1 missed
+    assert eng.prefix.check(eng.allocator) is None
+
+
+def test_prefix_eviction_under_pressure():
+    """A full index must never stall admission: unreferenced cached
+    prefixes evict LRU to make room, so a cache-on engine admits
+    everything a cache-off engine would."""
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    # 9 usable pages; each 8-token-prompt request needs 3 (8+16=24/8),
+    # so two busy slots leave 3 pages for cached prefixes — the 4th
+    # distinct prompt to finish MUST evict someone.
+    eng = Engine(
+        params, model=llama, cfg=cfg, num_slots=2, block_size=8,
+        num_blocks=10, max_model_len=64, decode_chunk=4, prefix_cache=True,
+    )
+    rng = np.random.default_rng(29)
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=8).astype(np.int32)
+        for _ in range(8)
+    ]
+    handles = [
+        eng.submit(p, max_new_tokens=16, key=500 + i)
+        for i, p in enumerate(prompts)
+    ]
+    eng.drain()
+    st = eng.stats()
+    # 8 distinct one-page prefixes cached into 9 usable pages alongside
+    # live requests (2 slots x 3 pages): the later admissions forced
+    # LRU evictions.
+    assert st["prefix_evictions"] >= 1, st
+    for i, (p, h) in enumerate(zip(prompts, handles)):
+        assert h.result() == solo(llama, cfg, params, p, 500 + i, 16)
+    assert eng.prefix.check(eng.allocator) is None
+    assert eng.allocator.num_in_use == len(eng.prefix)
+    eng.close()  # releases the index's pages with the engine
+    assert eng.allocator.num_in_use == 0
 
 
 def test_engine_stats_and_telemetry_spans():

@@ -281,6 +281,62 @@ def test_shed_policy_validation(family):
         Engine(params, model=model, cfg=cfg, shed_policy="lru", **ENGINE_KW)
 
 
+def test_overload_detector_chunked_estimates():
+    """Chunked prefill changes the unit of queue drain: a queued long
+    prompt costs ceil(suffix_chunks / max_prefills_per_tick) ticks, not
+    1 — the detector must weigh chunks, and the engine must feed it
+    chunk counts (the old depth-based estimate under-reports TTFT and
+    under-sheds)."""
+    det = OverloadDetector(max_queue=None, max_ttft_s=1.0)
+    det.observe_tick(0.5)
+    # Unchunked regime: depth doubles as the chunk count (1 chunk/req).
+    assert det.est_ttft_s(3, 1) == pytest.approx(2.0)
+    assert not det.overloaded(1, 1)  # 2 requests ahead ≈ 1.0s: at bound
+    # Chunked regime: ONE queued 16k prompt behind prefill_chunk=512 is
+    # 32 chunks — 16x the unchunked estimate at the same depth.
+    assert det.est_ttft_s(32, 1) == pytest.approx(16.5)
+    assert det.overloaded(1, 1, queued_chunks=32)  # same depth, now sheds
+    assert det.est_ttft_s(32, 8) == pytest.approx(2.5)  # ceil(33/8) ticks
+
+    # Engine wiring: the same prompt costs 1 chunk unchunked and many
+    # chunked, and est_ttft_s() reflects it (prompt 33 → suffix 33).
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    for chunk, want_chunks in ((512, 1), (8, 5)):
+        eng = Engine(
+            params, model=llama, cfg=cfg, max_queue=64,
+            prefill_chunk=chunk, num_slots=1, block_size=8,
+            max_model_len=64, decode_chunk=4, handle_preemption=False,
+        )
+        blocker = eng.submit(prompt_of(6), max_new_tokens=30, key=0)
+        eng.step()  # occupies the only slot: the queue cannot drain
+        eng.detector._tick_ewma_s = 0.5  # pin the EWMA for determinism
+        eng.submit(prompt_of(33), max_new_tokens=8, key=1)
+        assert eng._pending_prefill_chunks() == want_chunks
+        assert eng.est_ttft_s() == pytest.approx(
+            0.5 * (want_chunks + 1)
+        )
+        blocker.cancel()
+        eng.drain()
+        assert eng.allocator.num_in_use == 0
+
+    # The ARRIVAL's own chunks count toward its shed decision: a 33-token
+    # prompt behind prefill_chunk=8 is 5 chunks ≈ 2.5s of its own prefill
+    # wait — over a 1s bound even on an IDLE engine.
+    eng = Engine(
+        params, model=llama, cfg=cfg, max_ttft_s=1.0, prefill_chunk=8,
+        num_slots=1, block_size=8, max_model_len=64, decode_chunk=4,
+        handle_preemption=False,
+    )
+    eng.detector._tick_ewma_s = 0.5
+    with pytest.raises(EngineOverloaded):
+        eng.submit(prompt_of(33), max_new_tokens=8, key=0)
+    # A one-chunk prompt at the same moment is fine (1 tick * 0.5s).
+    h = eng.submit(prompt_of(6), max_new_tokens=2, key=1)
+    eng.drain()
+    assert len(h.result()) == 2
+
+
 def test_overload_detector_estimates():
     det = OverloadDetector(max_queue=4, max_ttft_s=1.0)
     assert det.enabled
@@ -402,7 +458,7 @@ def test_prefill_failure_keeps_fifo_order(monkeypatch, family):
         params, model=model, cfg=cfg, num_slots=2, block_size=8,
         max_model_len=64, decode_chunk=4, max_prefills_per_tick=2,
     )
-    real = eng_mod._prefill
+    real = eng_mod._prefill_chunk_last
     state = {"fail": True}
 
     def boom_first(*a, **k):
@@ -411,7 +467,7 @@ def test_prefill_failure_keeps_fifo_order(monkeypatch, family):
             raise RuntimeError("boom")
         return real(*a, **k)
 
-    monkeypatch.setattr(eng_mod, "_prefill", boom_first)
+    monkeypatch.setattr(eng_mod, "_prefill_chunk_last", boom_first)
     ha = eng.submit(prompt_of(4, base=1), max_new_tokens=4, key=0)
     hb = eng.submit(prompt_of(4, base=2), max_new_tokens=4, key=1)
     eng.step()  # A's prefill fails: batch [A, B] requeued, A still head

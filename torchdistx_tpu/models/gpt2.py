@@ -383,22 +383,22 @@ def forward_cached(params, tokens, cfg: GPT2Config, cache, pos):
 
 def forward_paged(params, tokens, cfg: GPT2Config, cache, block_tables,
                   positions):
-    """One decode step against a paged KV cache — per-slot positions
+    """``T`` tokens per slot against a paged KV cache — per-slot
+    positions; ``T == 1`` decode, ``T > 1`` a chunked-prefill block
     (see :func:`llama.forward_paged`; GPT-2: learned positional embeds,
     pre-LN biases, no GQA)."""
     from ..ops.attention import paged_attention, paged_write_index
 
     b, t = tokens.shape
-    if t != 1:
-        # One-token page scatter, as in llama.forward_paged.
-        raise ValueError(f"forward_paged decodes one token per slot (t={t})")
     pos_ids = positions[:, None] + jnp.arange(t)[None]
     x = jnp.take(params["wte"]["weight"], tokens, axis=0).astype(cfg.dtype)
+    # jnp.take clamps out-of-range ids: a chunk's padding tail past
+    # max_seq_len reads the last wpe row, and its K/V lands in trash.
     x = x + jnp.take(params["wpe"]["weight"], pos_ids, axis=0).astype(
         cfg.dtype
     )
     blk, off = paged_write_index(
-        block_tables, positions, cache["k"].shape[2]
+        block_tables, pos_ids, cache["k"].shape[2]
     )
 
     def block(carry, layer):
@@ -412,8 +412,8 @@ def forward_paged(params, tokens, cfg: GPT2Config, cache, block_tables,
         q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
-        kc = kc.at[i, blk, off].set(k[:, 0])
-        vc = vc.at[i, blk, off].set(v[:, 0])
+        kc = kc.at[i, blk, off].set(k)
+        vc = vc.at[i, blk, off].set(v)
         attn = paged_attention(
             q,
             jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),

@@ -585,45 +585,47 @@ def forward_cached(params, tokens, cfg: LlamaConfig, cache, pos):
 
 def forward_paged(params, tokens, cfg: LlamaConfig, cache, block_tables,
                   positions):
-    """One decode step against a block/paged KV cache (the serving path).
+    """``T`` tokens per slot against a block/paged KV cache (serving path).
 
-    ``tokens (B, 1)`` holds each slot's current token at its OWN position
-    ``positions (B,)`` — unlike :func:`forward_cached`, whose scalar ``pos``
-    forces every batch row to the same depth, so it cannot serve a
-    continuously batched decode where slots admit and retire independently.
-    ``cache`` is the paged pool ``{"k","v"}: (L, NB, bs, Hkv, Dh)`` and
-    ``block_tables (B, M)`` maps slot-logical blocks to pages (see
-    :mod:`torchdistx_tpu.serving`).
+    ``tokens (B, T)`` holds each slot's current tokens at its OWN
+    positions ``positions[b] .. positions[b]+T-1`` — unlike
+    :func:`forward_cached`, whose scalar ``pos`` forces every batch row
+    to the same depth, so it cannot serve a continuously batched decode
+    where slots admit and retire independently.  ``T == 1`` is the
+    decode step; ``T > 1`` is a **chunked-prefill block**: the chunk's
+    KV scatters into the slot's pages, then every chunk query attends
+    the slot's full cached prefix — shared prefix-cache pages included —
+    plus the chunk itself (causal).  ``cache`` is the paged pool
+    ``{"k","v"}: (L, NB, bs, Hkv, Dh)`` and ``block_tables (B, M)`` maps
+    slot-logical blocks to pages (see :mod:`torchdistx_tpu.serving`).
 
-    Returns ``(logits (B, 1, V) f32, new cache)``.  Same fused-weight layer
+    Returns ``(logits (B, T, V) f32, new cache)``.  Same fused-weight layer
     scan as :func:`forward_cached` (prep_decode applies; caches ride the
     scan carry), with the slice write/read swapped for a page scatter and
     the block-table gather of :func:`ops.attention.paged_attention` —
     values match the contiguous path exactly.
 
-    A slot whose ``positions[b]`` has run past its table (``pos//bs >= M``)
-    scatters into page 0 — the trash page the serving engine never hands
-    out — so a retired-but-still-batched slot can never corrupt a live
-    slot's cache.
+    A position that has run past its table (``pos//bs >= M``) scatters
+    into page 0 — the trash page the serving engine never hands out — so
+    a retired-but-still-batched slot (or a prefill chunk's padding tail)
+    can never corrupt a live slot's cache.
     """
     from ..ops.attention import paged_attention, paged_write_index
 
     if "wqkv" not in params["layers"]:
         params = prep_decode(params, cfg)
     b, t = tokens.shape
-    if t != 1:
-        # The page scatter below writes ONE token per slot; a t>1 call
-        # would silently drop the rest and attend to zeroed KV.
-        raise ValueError(f"forward_paged decodes one token per slot (t={t})")
     x = jnp.take(params["embed"]["weight"], tokens, axis=0).astype(cfg.dtype)
     n_q = cfg.n_heads * cfg.head_dim
     n_kv = cfg.n_kv_heads * cfg.head_dim
+    pos_bt = positions[:, None] + jnp.arange(t)[None]
     cos, sin = _rope_tables(
-        positions[:, None] + jnp.arange(t)[None],
-        cfg.rope_theta, cfg.head_dim // 2, cfg.dtype,
+        pos_bt, cfg.rope_theta, cfg.head_dim // 2, cfg.dtype,
     )
+    # (B, T) write steering: each token of the block lands in its slot's
+    # own pages (pads past the table steer to trash).
     blk, off = paged_write_index(
-        block_tables, positions, cache["k"].shape[2]
+        block_tables, pos_bt, cache["k"].shape[2]
     )
 
     def block(carry, layer):
@@ -640,8 +642,8 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache, block_tables,
         )
         q = _rope_apply(q, cos, sin)
         k = _rope_apply(k, cos, sin)
-        kc = kc.at[i, blk, off].set(k[:, 0])
-        vc = vc.at[i, blk, off].set(v[:, 0])
+        kc = kc.at[i, blk, off].set(k)
+        vc = vc.at[i, blk, off].set(v)
         attn = paged_attention(
             q,
             jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),

@@ -117,27 +117,37 @@ def cached_attention(q, k_cache, v_cache, pos):
 
 
 def paged_write_index(block_tables, positions, block_size):
-    """Page/offset each slot's CURRENT token writes to: ``(blk, off)``,
-    both ``(B,)`` int32.
+    """Page/offset each token writes to: ``(blk, off)``, int32, shaped
+    like ``positions``.
+
+    ``positions`` may be ``(B,)`` — each slot's ONE decode token — or
+    ``(B, T)`` — a chunked-prefill block of ``T`` suffix tokens per
+    slot, positions ``start_b .. start_b+T-1`` (the chunked ``write_prompt``
+    scatter rides this same rule).
 
     The ONE definition of the paged cache's write-steering rule, shared
     by every family's ``forward_paged`` (llama, gpt2) and the prefill
     scatter (``serving.cache.write_prompt``, table broadcast per
     position) — it is safety-critical for cache isolation, so it must
     not fork per call site:
-    a slot whose position has run past its table (``pos//bs >= M``)
+    a position that has run past its table (``pos//bs >= M``)
     steers into page 0, the trash page the serving allocator never hands
     out (:data:`torchdistx_tpu.serving.blocks.TRASH_BLOCK`), so a
-    retired-but-still-batched slot can never scribble on a live slot's
-    pages.
+    retired-but-still-batched slot (or a chunk's padding tail) can never
+    scribble on a live slot's pages.
     """
     import jax.numpy as jnp
 
     m = block_tables.shape[1]
     blk_no = positions // block_size
-    blk = jnp.take_along_axis(
-        block_tables, jnp.clip(blk_no, 0, m - 1)[:, None], axis=1
-    )[:, 0]
+    if positions.ndim == 1:
+        blk = jnp.take_along_axis(
+            block_tables, jnp.clip(blk_no, 0, m - 1)[:, None], axis=1
+        )[:, 0]
+    else:  # (B, T): T gathers per slot from its own table row
+        blk = jnp.take_along_axis(
+            block_tables, jnp.clip(blk_no, 0, m - 1), axis=1
+        )
     blk = jnp.where(blk_no < m, blk, 0)
     return blk, positions % block_size
 
@@ -146,7 +156,10 @@ def paged_attention(q, k_pages, v_pages, block_tables, positions):
     """Decode-time attention against a block/paged KV cache (serving path).
 
     q ``(B, T, Hq, D)`` holds slot ``b``'s queries for positions
-    ``positions[b] .. positions[b]+T-1``; ``k_pages``/``v_pages``
+    ``positions[b] .. positions[b]+T-1`` — ``T == 1`` is a decode step;
+    ``T > 1`` is a chunked-prefill block attending the slot's cached
+    prefix (shared pages included) plus itself, the partial-prefix
+    attention of the prefix cache.  ``k_pages``/``v_pages``
     ``(NB, bs, Hkv, D)`` are the one-layer page pools; ``block_tables``
     ``(B, M)`` int32 maps slot ``b``'s logical block ``j`` to its page.
     Gathers each slot's pages into a contiguous ``(B, M*bs, Hkv, D)`` view

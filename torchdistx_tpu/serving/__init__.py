@@ -6,8 +6,13 @@ that must finish together, with ``prompt + max_new_tokens`` cache
 allocated per row up front.  This package replaces that for serving:
 
 * :mod:`.blocks` — host-side page allocator (fixed-size KV pages,
-  admit/finish granularity, backpressure on exhaustion);
-* :mod:`.cache`  — the device page pools + the jitted prompt scatter;
+  refcounted for prefix sharing, admit/finish granularity, backpressure
+  on exhaustion);
+* :mod:`.cache`  — the device page pools, the jitted prompt scatter,
+  and the copy-on-write page copy;
+* :mod:`.prefix` — the refcounted prefix index: full prompt pages
+  content-addressed by chained hash, shared across requests, LRU-evicted
+  under allocator pressure (``Engine(prefix_cache=True)``);
 * :mod:`.engine` — the continuous-batching :class:`~.engine.Engine`
   (one compiled decode chunk over fixed slots, per-bucket compiled
   prefill, slot recycling at chunk boundaries);
@@ -47,7 +52,7 @@ failover, and zero-downtime weight hot swap (docs/fleet.md).
 """
 
 from .blocks import BlockAllocator, blocks_needed  # noqa: F401
-from .cache import fresh_pool, init_paged_cache, write_prompt  # noqa: F401
+from .cache import copy_pages, fresh_pool, init_paged_cache, write_prompt  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .lifecycle import (  # noqa: F401
     DeadlineExceeded,
@@ -60,6 +65,7 @@ from .lifecycle import (  # noqa: F401
     RequestError,
     RequestPreempted,
 )
+from .prefix import PrefixIndex, page_hashes  # noqa: F401
 from .scheduler import FIFOScheduler, Request, RequestHandle  # noqa: F401
 
 __all__ = [
@@ -71,6 +77,7 @@ __all__ = [
     "FIFOScheduler",
     "Health",
     "OverloadDetector",
+    "PrefixIndex",
     "RecoveryFailed",
     "Request",
     "RequestCancelled",
@@ -78,7 +85,9 @@ __all__ = [
     "RequestHandle",
     "RequestPreempted",
     "blocks_needed",
+    "copy_pages",
     "fresh_pool",
     "init_paged_cache",
+    "page_hashes",
     "write_prompt",
 ]

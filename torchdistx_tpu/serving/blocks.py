@@ -12,19 +12,29 @@ overshoot) is steered into it.  Scribbling on trash is safe by
 construction — the attention mask zeroes any read of a page outside a
 slot's own table (:func:`torchdistx_tpu.ops.attention.paged_attention`).
 
+Pages are **refcounted** (vLLM-style prefix sharing): ``alloc`` hands a
+page out with one reference, ``share()`` adds references — the prefix
+index and every request mapping a cached prefix hold one each — and
+``free()`` removes one, returning the page to the free list only when
+the last reference drops.  A page with more than one reference is
+*shared*: writers must copy-on-write before touching it (the engine's
+job; the allocator only exposes :meth:`refcount`).
+
 Invariants (enforced, not assumed):
 
-* a page is owned by at most one request at a time (double-assignment
-  raises);
-* ``free()`` of a page not currently owned raises (double-free / stray
-  free);
+* ``alloc`` never hands out a page that still has references
+  (double-assignment raises);
+* ``free()``/``share()`` of a page with no references raises
+  (double-free / stray free / stray share);
 * exhaustion is a ``None`` return, not an exception — the scheduler turns
-  it into backpressure (the request waits in the FIFO).
+  it into backpressure (the request waits in the FIFO);
+* ``utilization()``/``num_in_use`` count **physical** pages: a page
+  shared by five requests is one page of HBM, not five.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .. import telemetry as _telemetry
 
@@ -53,7 +63,7 @@ class BlockAllocator:
         # LIFO free list: recently-freed (still-warm) pages are reused
         # first.  Deterministic: same admit/finish order → same tables.
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._in_use: set = set()
+        self._ref: Dict[int, int] = {}  # page -> live reference count
 
     @property
     def capacity(self) -> int:
@@ -66,11 +76,18 @@ class BlockAllocator:
 
     @property
     def num_in_use(self) -> int:
-        return len(self._in_use)
+        """PHYSICAL pages with at least one reference (shared pages count
+        once — this is HBM occupancy, not the sum of refcounts)."""
+        return len(self._ref)
+
+    def refcount(self, blk: int) -> int:
+        """Live references on ``blk`` (0 = free).  A result > 1 means the
+        page is shared and a writer must copy-on-write first."""
+        return self._ref.get(blk, 0)
 
     def utilization(self) -> float:
-        """Fraction of allocatable pages currently owned."""
-        return len(self._in_use) / self.capacity
+        """Fraction of allocatable pages currently owned (physical)."""
+        return len(self._ref) / self.capacity
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
@@ -82,11 +99,24 @@ class BlockAllocator:
             return None
         out = [self._free.pop() for _ in range(n)]
         for blk in out:
-            if blk in self._in_use or blk == TRASH_BLOCK:
+            if blk in self._ref or blk == TRASH_BLOCK:
                 raise RuntimeError(f"block allocator double-assigned page {blk}")
-            self._in_use.add(blk)
+            self._ref[blk] = 1
         _G_UTIL.set(round(self.utilization(), 4))
         return out
+
+    def share(self, blocks: List[int]) -> None:
+        """Add one reference to each page (prefix-cache mapping: the page
+        now also backs the sharer's block table).  Sharing a page with no
+        live references raises — a cached page must already be owned by
+        the index or a request."""
+        for blk in blocks:
+            if blk not in self._ref:
+                raise RuntimeError(
+                    f"sharing page {blk} that is not in use (stray share)"
+                )
+        for blk in blocks:
+            self._ref[blk] += 1
 
     def reset(self) -> None:
         """Forget every grant and rebuild the full free list.
@@ -98,16 +128,25 @@ class BlockAllocator:
         Page order matches a fresh allocator, so a deterministic replay
         produces deterministic tables."""
         self._free = list(range(self.num_blocks - 1, 0, -1))
-        self._in_use = set()
+        self._ref = {}
         _G_UTIL.set(0.0)
 
     def free(self, blocks: List[int]) -> None:
-        """Return pages to the free list; freeing an unowned page raises."""
+        """Drop one reference per page; a page whose LAST reference drops
+        returns to the free list.  Freeing a page with no references
+        raises (double free / stray free) — BEFORE any reference moves,
+        so a failed call never half-applies."""
+        counts: Dict[int, int] = {}
         for blk in blocks:
-            if blk not in self._in_use:
+            counts[blk] = counts.get(blk, 0) + 1
+        for blk, n in counts.items():
+            if self._ref.get(blk, 0) < n:
                 raise RuntimeError(
                     f"freeing page {blk} that is not in use (double free?)"
                 )
-            self._in_use.remove(blk)
-            self._free.append(blk)
+        for blk in blocks:
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                del self._ref[blk]
+                self._free.append(blk)
         _G_UTIL.set(round(self.utilization(), 4))

@@ -6,14 +6,24 @@ and dtype are probed from the family's own ``init_cache`` via
 ``jax.eval_shape`` — zero model coupling, so any family implementing the
 cache protocol (llama, gpt2, future ones) pages identically.
 
-Two device programs live here:
+Three device programs live here:
 
 * :func:`init_paged_cache` — allocate the zeroed pool.
 * :func:`write_prompt` — scatter a *contiguous* prefill cache (what the
   family's unchanged ``forward_cached`` produced for the padded prompt)
   into a slot's pages.  Pad positions (``>= length``) and positions past
   the table are steered into the trash page.  Jitted per prompt bucket;
-  the pool is donated so the scatter updates in place on TPU.
+  the pool is donated so the scatter updates in place on TPU.  (The
+  engine's chunked prefill writes through ``forward_paged``'s own
+  scatter instead — same steering rule,
+  :func:`~torchdistx_tpu.ops.attention.paged_write_index` — so prompt
+  KV lands page by page as each chunk computes; ``write_prompt`` remains
+  the one-shot contiguous path.)
+* :func:`copy_pages` — duplicate one physical page across every layer of
+  both pools: the **copy-on-write** primitive of the prefix cache.  A
+  stream about to write into a page whose refcount is > 1 (shared with
+  the prefix index or another stream) gets its own copy first, so shared
+  history is immutable.
 """
 
 from __future__ import annotations
@@ -25,7 +35,7 @@ import jax.numpy as jnp
 
 from .blocks import TRASH_BLOCK
 
-__all__ = ["fresh_pool", "init_paged_cache", "write_prompt"]
+__all__ = ["copy_pages", "fresh_pool", "init_paged_cache", "write_prompt"]
 
 
 def init_paged_cache(model, cfg, num_blocks: int, block_size: int):
@@ -87,3 +97,17 @@ def write_prompt(paged, contiguous, table, length, *, block_size: int):
         return pool.at[:, blk, off].set(cont[:, 0])
 
     return jax.tree.map(scatter, paged, contiguous)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def copy_pages(paged, src, dst):
+    """Copy physical page ``src`` onto ``dst`` in every layer of both
+    pools (the prefix cache's copy-on-write).  ``src``/``dst`` are
+    traced scalars — one compile serves every copy.  The pool is donated:
+    the copy happens in place on device, no host round-trip."""
+
+    def cp(pool):
+        row = jax.lax.dynamic_index_in_dim(pool, src, axis=1, keepdims=False)
+        return jax.lax.dynamic_update_index_in_dim(pool, row, dst, axis=1)
+
+    return jax.tree.map(cp, paged)

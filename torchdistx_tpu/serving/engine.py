@@ -5,12 +5,29 @@ vLLM-style paged cache (Kwon et al., SOSP '23), specialized for the TPU
 idiom of this stack: **two compiled programs total** serve any traffic
 mix —
 
-* a jitted **prefill** per prompt-length bucket: the family's unchanged
-  ``forward_cached`` over the padded prompt, first-token sampling, and
-  the page scatter (:func:`.cache.write_prompt`), all one program;
+* a jitted **prefill chunk** per chunk-length bucket: ``prefill_chunk``
+  suffix tokens through the family's ``forward_paged`` — the chunk's KV
+  scatters into the request's pages and every chunk query attends the
+  request's full cached prefix (partial-prefix attention over the block
+  table) plus itself; the final chunk also samples the first token.  A
+  prompt longer than one chunk prefills across ticks, **interleaved**
+  with decode chunks (``max_prefills_per_tick`` now budgets CHUNKS per
+  tick), so a 16k-token prompt never head-of-line blocks the running
+  streams for more than one chunk;
 * ONE jitted **decode chunk**: ``decode_chunk`` steps of the family's
   ``forward_paged`` over all ``num_slots`` slots, ``lax.scan``-fused so
   the host syncs once per chunk, not once per token.
+
+**Prefix caching** (``prefix_cache=True``): full pages of prompt tokens
+are content-addressed in a refcounted LRU index
+(:class:`.prefix.PrefixIndex`).  A new request whose prompt extends a
+cached prefix maps those pages into its block table
+(:meth:`.blocks.BlockAllocator.share`) and prefills only the un-cached
+suffix; a stream about to write into a shared page gets a private copy
+first (**copy-on-write**, :func:`.cache.copy_pages` — never the trash
+page).  Unreferenced cached prefixes evict LRU under allocator
+pressure, so the cache can never cause an admission stall an empty
+cache would not.
 
 Slots admit and retire independently — the moment a sequence hits EOS or
 its token budget (observed at the next chunk boundary), its pages free
@@ -78,7 +95,7 @@ from ..models.generate import _sample
 from ..resilience import faults
 from ..resilience import preemption as _preemption
 from .blocks import BlockAllocator, blocks_needed
-from .cache import fresh_pool, init_paged_cache, write_prompt
+from .cache import copy_pages, fresh_pool, init_paged_cache
 from .lifecycle import (
     DeadlineExceeded,
     EngineDraining,
@@ -89,6 +106,7 @@ from .lifecycle import (
     RequestCancelled,
     RequestPreempted,
 )
+from .prefix import PrefixIndex, page_hashes
 from .scheduler import FIFOScheduler, Request, RequestHandle
 
 __all__ = ["Engine"]
@@ -106,6 +124,10 @@ _T_CANCELLED = _telemetry.counter("serve.cancelled")
 _T_RECOVERIES = _telemetry.counter("serve.recoveries")
 _T_RECOVERY_FAILURES = _telemetry.counter("serve.recovery_failures")
 _T_PREEMPTED = _telemetry.counter("serve.preempted")
+_T_PREFIX_HITS = _telemetry.counter("serve.prefix_hits")
+_T_PREFIX_HIT_TOKENS = _telemetry.counter("serve.prefix_hit_tokens")
+_T_COW = _telemetry.counter("serve.cow_copies")
+_T_PREFIX_EVICTIONS = _telemetry.counter("serve.prefix_evictions")
 _G_RUNNING = _telemetry.gauge("serve.running_slots")
 _G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
 _G_TTFT = _telemetry.gauge("serve.ttft_s")
@@ -115,30 +137,49 @@ _G_HEALTH = _telemetry.gauge("serve.health")
 
 @partial(
     jax.jit,
-    static_argnames=(
-        "model", "cfg", "temperature", "top_k", "block_size",
-    ),
+    static_argnames=("model", "cfg"),
     donate_argnums=(1,),
 )
-def _prefill(
-    params, paged, prompt, length, key, table,
-    *, model, cfg, temperature, top_k, block_size,
+def _prefill_chunk(params, paged, tokens, start, table, *, model, cfg):
+    """Compiled NON-final prefill chunk: ``tokens (1, Cb)`` — suffix
+    tokens at positions ``start .. start+Cb-1`` — through the family's
+    ``forward_paged``: the chunk's KV scatters into the request's pages
+    and every chunk query attends the cached prefix (shared pages
+    included) plus itself.  Logits are returned to nobody — XLA dead-code
+    eliminates the head matmul.  One compile per chunk bucket."""
+    _, paged = model.forward_paged(
+        params, tokens, cfg, paged, table[None], start
+    )
+    return paged
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "cfg", "temperature", "top_k"),
+    donate_argnums=(1,),
+)
+def _prefill_chunk_last(
+    params, paged, tokens, start, last_idx, key, table,
+    *, model, cfg, temperature, top_k,
 ):
-    """Compiled prefill: contiguous forward over the padded prompt,
-    first-token sample (``fold_in(key, 0)`` — ``generate``'s schedule),
-    and the page scatter.  One compile per prompt bucket.  Recovery
-    replays reuse this same program over ``prompt + generated-so-far``
-    and discard the sampled token."""
-    p_pad = prompt.shape[1]
-    scratch = model.init_cache(cfg, 1, p_pad)
-    logits, scratch = model.forward_cached(params, prompt, cfg, scratch, 0)
+    """Compiled FINAL prefill chunk: the chunk scatter/attention of
+    :func:`_prefill_chunk` plus the first-token sample from the last
+    real token's logits (``fold_in(key, 0)`` — ``generate``'s schedule,
+    so outputs stay token-identical whatever the chunking).  Positions
+    past ``last_idx`` are padding: their KV lands in the request's own
+    not-yet-decoded tail (overwritten by decode before it is ever read)
+    or the trash page, and their logits are ignored.  Recovery replays
+    reuse this same program over ``prompt + generated-so-far`` and
+    discard the sampled token."""
+    logits, paged = model.forward_paged(
+        params, tokens, cfg, paged, table[None], start
+    )
     last = jax.lax.dynamic_index_in_dim(
-        logits, length - 1, axis=1, keepdims=False
+        logits, last_idx, axis=1, keepdims=False
     )
     first = _sample(
         last, jax.random.fold_in(key, 0), temperature, top_k
     ).astype(jnp.int32)[0]
-    paged = write_prompt(paged, scratch, table, length, block_size=block_size)
     return first, paged
 
 
@@ -202,8 +243,22 @@ class Engine:
         chunk boundaries, so large chunks trade slot-turnaround (and thus
         a little throughput under churn) for far fewer host round-trips.
         Deadlines/cancellations are also observed at chunk boundaries.
-    max_prefills_per_tick : the prefill/decode interleave knob
-        (see :class:`.scheduler.FIFOScheduler`).
+    max_prefills_per_tick : the prefill/decode interleave knob, now in
+        prefill CHUNKS per tick (see :class:`.scheduler.FIFOScheduler`);
+        for prompts no longer than ``prefill_chunk`` it is the old
+        requests-per-tick knob unchanged.
+    prefill_chunk : prefill tokens dispatched per compiled chunk.  A
+        prompt suffix longer than this splits across ticks, interleaved
+        with decode — a 16k prompt stalls running streams for at most
+        one chunk's forward per tick instead of the whole prompt's.
+        Smaller chunks mean smoother decode but more dispatches (and the
+        per-chunk block-table attention re-reads the prefix).
+    prefix_cache : content-address full prompt pages in a refcounted LRU
+        index so requests sharing a cached prefix skip its prefill
+        (copy-on-write on divergence, LRU eviction under pressure).
+        Off by default: sharing keeps finished requests' pages resident,
+        which changes ``num_in_use`` accounting that embedding code may
+        assert on; outputs are token-identical either way.
     max_queue / max_ttft_s : the overload detector's bounds (both None →
         never overloaded; see :class:`.lifecycle.OverloadDetector`).
     shed_policy : ``"reject-new"`` (overloaded ``submit`` raises
@@ -241,6 +296,8 @@ class Engine:
         eos_id: Optional[int] = None,
         decode_chunk: int = 8,
         max_prefills_per_tick: int = 1,
+        prefill_chunk: int = 512,
+        prefix_cache: bool = False,
         min_prefill_bucket: int = 16,
         max_queue: Optional[int] = None,
         max_ttft_s: Optional[float] = None,
@@ -269,9 +326,12 @@ class Engine:
         self.decode_chunk = int(decode_chunk)
         if self.decode_chunk < 1:
             raise ValueError("decode_chunk must be >= 1")
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.min_prefill_bucket = int(min_prefill_bucket)
         if self.min_prefill_bucket < 1:
-            # _bucket doubles up from this value; <= 0 would never
+            # _chunk_bucket doubles up from this value; <= 0 would never
             # terminate.
             raise ValueError("min_prefill_bucket must be >= 1")
         if shed_policy not in ("reject-new", "drop-oldest"):
@@ -292,6 +352,9 @@ class Engine:
         self.allocator = BlockAllocator(num_blocks, block_size)
         self.scheduler = FIFOScheduler(max_prefills_per_tick)
         self.detector = OverloadDetector(max_queue, max_ttft_s)
+        self.prefix: Optional[PrefixIndex] = (
+            PrefixIndex(block_size) if prefix_cache else None
+        )
 
         prep = getattr(model, "prep_decode", None)
         self._params = prep(params, cfg) if prep is not None else params
@@ -306,6 +369,12 @@ class Engine:
         self._keys = np.zeros((s, 2), np.uint32)
         self._tables = np.zeros((s, self._table_width), np.int32)
         self._emitted = np.zeros((s,), np.int64)  # tokens pushed to handles
+        # Slots mid-prefill, in admission order: they hold pages and a
+        # slot but are NOT in the decode batch (their device-visible
+        # table stays 0 → trash) until their last chunk samples the
+        # first token.  Strict FIFO: the head gets every chunk of the
+        # tick's budget until it completes.
+        self._prefill_q: list[int] = []
 
         self._next_rid = 0
         self._admit_no = 0  # admission attempts (serve.admit fault site)
@@ -320,6 +389,7 @@ class Engine:
         self._n_cancelled = 0
         self._n_recoveries = 0
         self._n_preempted = 0
+        self._n_cow = 0
         # Bounded: stats() reports percentiles over the most recent
         # window, and a long-lived engine must not grow per-request state.
         self._ttft = deque(maxlen=4096)
@@ -374,14 +444,6 @@ class Engine:
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens})"
                 f" = {total} exceeds max_model_len ({self.max_model_len})"
             )
-        if len(prompt) > self._bucket(len(prompt)):
-            # Unreachable while _bucket caps at max_model_len >= total,
-            # but pinned: a prompt wider than the widest prefill bucket
-            # would admit and then crash (or worse, truncate) at prefill.
-            raise ValueError(
-                f"prompt ({len(prompt)}) exceeds the widest prefill "
-                f"bucket ({self._bucket(len(prompt))})"
-            )
         if blocks_needed(total, self.block_size) > self.allocator.capacity:
             raise ValueError(
                 "request needs more pages than the engine owns "
@@ -401,8 +463,28 @@ class Engine:
             raise EngineDraining(
                 f"engine is {self._health.value}; submit to another replica"
             )
+        # Prefill cost in chunks: the TTFT estimate drains the queue at
+        # max_prefills_per_tick CHUNKS per tick, so a long prompt must
+        # weigh as many chunks, not 1.  A prefix-cache hit shrinks the
+        # suffix (probe only — no refcounts taken; the authoritative
+        # match happens at admission).
+        suffix = len(prompt)
+        hashes = None
+        if self.prefix is not None:
+            # Hashed ONCE per request: admission reuses these (the hash
+            # is a pure function of the prompt).
+            hashes = page_hashes(prompt, self.block_size)
+            suffix = max(
+                1, len(prompt) - self.prefix.probe(hashes) * self.block_size
+            )
+        n_chunks = -(-suffix // self.prefill_chunk)
+        # The arrival's OWN prefill cost counts too: a 16k prompt on an
+        # idle engine still waits n_chunks ticks for its first token.
+        # The detector's estimate adds one chunk for the arrival, so
+        # pass the remaining n_chunks - 1 alongside the queue's.
         if self.detector.overloaded(
-            len(self.scheduler), self.max_prefills_per_tick
+            len(self.scheduler), self.max_prefills_per_tick,
+            queued_chunks=self._pending_prefill_chunks() + n_chunks - 1,
         ):
             self._set_health(Health.OVERLOADED)
             if self.shed_policy == "reject-new":
@@ -411,7 +493,7 @@ class Engine:
                 raise EngineOverloaded(
                     "engine overloaded "
                     f"(queue={len(self.scheduler)}, est_ttft="
-                    f"{self.detector.est_ttft_s(len(self.scheduler), self.max_prefills_per_tick):.3f}s);"
+                    f"{self.est_ttft_s():.3f}s);"
                     " retry with backoff"
                 )
             victim = self.scheduler.shed_oldest()
@@ -433,7 +515,7 @@ class Engine:
         self.scheduler.push(
             Request(
                 rid, prompt, int(max_new_tokens), key, handle,
-                deadline=deadline,
+                deadline=deadline, n_chunks=n_chunks, hashes=hashes,
             )
         )
         _T_REQUESTS.add()
@@ -456,8 +538,20 @@ class Engine:
         of replicas in one process shares that gauge, so anything
         load-balancing across engines must read this instead."""
         return self.detector.est_ttft_s(
-            len(self.scheduler), self.max_prefills_per_tick
+            self._pending_prefill_chunks(), self.max_prefills_per_tick
         )
+
+    def _pending_prefill_chunks(self) -> int:
+        """Prefill work ahead of a new arrival, in chunks: the waiting
+        queue's estimates plus the un-prefilled remainder of every slot
+        mid-prefill."""
+        pending = self.scheduler.pending_prefill_chunks()
+        for slot in self._prefill_q:
+            req = self._slot_req[slot]
+            if req is not None:
+                left = max(1, len(req.prompt) - req.prefill_pos)
+                pending += -(-left // self.prefill_chunk)
+        return pending
 
     def begin_drain(self) -> None:
         """Start a graceful drain NOW, without a preemption signal.
@@ -478,13 +572,20 @@ class Engine:
     def _n_running(self) -> int:
         return sum(r is not None for r in self._slot_req)
 
+    def _n_decoding(self) -> int:
+        """Slots in the decode batch (occupied and past their prefill)."""
+        return sum(
+            r is not None for i, r in enumerate(self._slot_req)
+            if i not in self._prefill_q
+        )
+
     # ------------------------------------------------------------------
     # The engine tick
 
     def step(self) -> None:
         """One tick: act on preemption, reap expired/cancelled requests,
-        admit + prefill (up to the interleave knob), then one decode
-        chunk over the running slots."""
+        admit, advance prefills (up to ``max_prefills_per_tick`` chunks),
+        then one decode chunk over the running slots."""
         if self._health is Health.STOPPED:
             # Raising (rather than a silent no-op) keeps a stray
             # handle.tokens() loop from spinning a dead engine forever.
@@ -495,13 +596,17 @@ class Engine:
         self._reap_phase()
         if self._health is not Health.DRAINING:
             self._admit_phase()
+        # Chunks advance even while DRAINING: a slot mid-prefill is
+        # in-flight work the drain contract promises to finish.
+        self._advance_prefills()
         self._decode_phase()
         if self._health is Health.DRAINING:
             self._drain_tick()
         elif self._health is Health.STARTING:
             self._set_health(Health.READY)
         elif self._health is Health.OVERLOADED and not self.detector.overloaded(
-            len(self.scheduler), self.max_prefills_per_tick
+            len(self.scheduler), self.max_prefills_per_tick,
+            queued_chunks=self._pending_prefill_chunks(),
         ):
             self._set_health(Health.READY)
         self.detector.observe_tick(time.perf_counter() - t0)
@@ -514,14 +619,7 @@ class Engine:
         if self._health is not Health.STOPPED:
             _G_HEALTH.set(self._health.value)
             if self.detector.enabled:
-                _G_EST_TTFT.set(
-                    round(
-                        self.detector.est_ttft_s(
-                            len(self.scheduler), self.max_prefills_per_tick
-                        ),
-                        4,
-                    )
-                )
+                _G_EST_TTFT.set(round(self.est_ttft_s(), 4))
         _G_RUNNING.set(self._n_running())
 
     # ------------------------------------------------------------------
@@ -626,6 +724,10 @@ class Engine:
         if self._drain_sp is not None:
             self._drain_sp.end(timed_out=timed_out)
             self._drain_sp = None
+        if self.prefix is not None:
+            # Cached prefixes die with the engine: drop the index's page
+            # references so a stopped engine owns nothing.
+            self.prefix.release(self.allocator)
         self._set_health(Health.STOPPED)
         # The serving gauges are process-global: a stopped engine must
         # not leave its last readings behind for a router (or an
@@ -677,6 +779,11 @@ class Engine:
     def _admit_phase(self) -> None:
         if not len(self.scheduler):
             return
+        if self._prefill_q:
+            # Prefill-busy: popping more requests would only park them on
+            # pages with zero progress (chunks drain strictly FIFO).
+            # Admission resumes the tick the queue of chunks empties.
+            return
         free_slots = [
             i for i, r in enumerate(self._slot_req) if r is None
         ]
@@ -702,130 +809,313 @@ class Engine:
             _T_ADMIT_RETRIES.add()
             return
         batch = self.scheduler.pop_admissible(
-            len(free_slots), self.allocator, self.block_size
+            len(free_slots), self.allocator, self.block_size,
+            reclaim=self._reclaim_pages,
         )
         for i, req in enumerate(batch):
+            try:
+                self._start_prefill(free_slots[i], req)
+            except (KeyboardInterrupt, SystemExit):
+                self.scheduler.requeue([req] + batch[i + 1:])
+                raise
+            except Exception:
+                # Host-side reservation failure (nothing dispatched, the
+                # reservation rolled back): the request — and the rest
+                # of the batch, which must not jump it — returns to the
+                # FIFO head.
+                _T_PREFILL_RETRIES.add()
+                self.scheduler.requeue([req] + batch[i + 1:])
+                return
+
+    # ------------------------------------------------------------------
+    # Chunked prefill + the prefix cache
+
+    def _reclaim_pages(self, n: int) -> int:
+        """Evict up to ``n`` unreferenced cached-prefix pages (LRU) —
+        the allocator-pressure valve admission and CoW pull."""
+        if self.prefix is None:
+            return 0
+        freed = self.prefix.evict(n, self.allocator)
+        if freed:
+            _T_PREFIX_EVICTIONS.add(freed)
+        return freed
+
+    def _alloc_pages(self, n: int) -> Optional[list]:
+        """``allocator.alloc`` with the prefix cache as the fallback
+        reserve: under pressure, cached-but-unreferenced pages evict LRU
+        before an allocation fails."""
+        if n == 0:
+            return []
+        got = self.allocator.alloc(n)
+        if got is None and self.prefix is not None:
+            self._reclaim_pages(n - self.allocator.num_free)
+            got = self.allocator.alloc(n)
+        return got
+
+    def _start_prefill(self, slot: int, req: Request) -> None:
+        """Host-side admission of one request into the PREFILLING state:
+        map the longest cached prefix (shared, refcounted), reserve
+        private pages for the rest of the table, and queue the slot for
+        chunk dispatch.  No device work happens here; on any failure the
+        reservation rolls back completely."""
+        n_total = blocks_needed(req.cache_tokens, self.block_size)
+        shared: list = []
+        cached_len = 0
+        if self.prefix is not None:
+            if req.hashes is None:  # belt-and-braces: submit() hashed once
+                req.hashes = page_hashes(req.prompt, self.block_size)
+            shared = self.prefix.match(req.hashes)
+            if shared:
+                self.allocator.share(shared)
+                cached_len = len(shared) * self.block_size
+        priv = self._alloc_pages(n_total - len(shared))
+        if priv is None:
+            # pop_admissible reserved the FULL quota, so this is only
+            # reachable if the map changed under us (supervisor reset
+            # mid-tick); undo the share and let the caller requeue.
+            if shared:
+                self.allocator.free(shared)
+            raise RuntimeError("prefill could not reserve its promised pages")
+        if cached_len and not req.hit_counted:
+            # Counted once per REQUEST, not per admission attempt — a
+            # transiently-failed prefill that requeues and re-admits
+            # must not inflate the hit rate past 1.0.
+            req.hit_counted = True
+            self.prefix.hits += 1
+            self.prefix.hit_tokens += cached_len
+            _T_PREFIX_HITS.add()
+            _T_PREFIX_HIT_TOKENS.add(cached_len)
+        req.blocks = shared + priv
+        table = np.zeros((self._table_width,), np.int32)
+        table[: len(req.blocks)] = req.blocks
+        req.table = table
+        req.n_cached = cached_len
+        # Full-prompt hit: the first sample still needs the last token's
+        # logits, so recompute exactly that token — its write lands in
+        # the final shared page, which copy-on-write privatizes first.
+        req.prefill_pos = min(cached_len, len(req.prompt) - 1)
+        self._slot_req[slot] = req
+        # Slot arrays stay idle (done=True, device table 0 → trash)
+        # until the last chunk installs them — the decode batch must not
+        # see a half-prefilled slot.
+        self._prefill_q.append(slot)
+
+    def _advance_prefills(self) -> None:
+        """Dispatch up to ``max_prefills_per_tick`` prefill chunks,
+        strictly FIFO: the head slot gets the whole budget until its
+        prompt completes — that is what bounds a 16k prompt's impact on
+        running streams to one chunk per tick."""
+        budget = self.max_prefills_per_tick
+        while budget > 0 and self._prefill_q:
+            slot = self._prefill_q[0]
+            req = self._slot_req[slot]
+            start = req.prefill_pos
+            end = min(start + self.prefill_chunk, len(req.prompt))
             self._prefill_no += 1
             try:
                 kind = faults.fire("serve.prefill", self._prefill_no)
             except OSError:
-                # Transient prefill failure before dispatch: the request
-                # (and the rest of the batch) returns to the FIFO head.
+                # Transient: chunk state is intact (nothing dispatched);
+                # the next tick retries this same chunk.
                 _T_PREFILL_RETRIES.add()
-                self.scheduler.requeue([req] + batch[i + 1:])
                 return
-            except BaseException:
-                # Fatal kinds propagate, but the popped request must not
-                # vanish from every queue on the way out — a handle in
-                # neither the FIFO nor a slot spins tokens() forever.
-                self.scheduler.requeue([req] + batch[i + 1:])
-                raise
             if kind is not None:  # nan: poisoned prefill tick — skip it
                 _T_PREFILL_RETRIES.add()
-                self.scheduler.requeue([req] + batch[i + 1:])
                 return
-            slot = free_slots.pop(0)
             try:
-                self._prefill_into(slot, req)
+                first = self._dispatch_chunk(slot, req, start, end)
             except (KeyboardInterrupt, SystemExit):
-                self.scheduler.requeue([req] + batch[i + 1:])
                 raise
             except faults.FatalInjectedFault:
-                self.scheduler.requeue([req] + batch[i + 1:])
                 raise
             except Exception as err:
-                # Supervised prefill: the reservation was already
-                # released (see _prefill_into); if the donated pool was
-                # consumed, rebuild it and replay the live slots, then
-                # charge THIS request's budget and retry it from the
-                # queue — or fail it typed once the budget is gone.
-                if self._pool_lost():
-                    self._supervise_recovery(err)
-                req.recoveries += 1
-                if req.recoveries > self.max_recoveries:
-                    _T_RECOVERY_FAILURES.add()
-                    req.handle._fail(
-                        RecoveryFailed(
-                            f"request {req.rid} aborted: prefill failed "
-                            f"{req.recoveries} times ({err!r})"
-                        )
-                    )
-                    self.scheduler.requeue(batch[i + 1:])
-                else:
-                    _T_PREFILL_RETRIES.add()
-                    # ONE requeue call: the failed request must land at
-                    # the head, AHEAD of its batch-mates (two calls
-                    # would appendleft the tail in front of it).
-                    self.scheduler.requeue([req] + batch[i + 1:])
+                self._on_prefill_failure(req, err)
                 return
+            req.prefill_pos = end
+            budget -= 1
+            if first is not None:
+                self._prefill_q.pop(0)
+                self._complete_prefill(slot, req, first)
 
-    def _prefill_dispatch(self, req: Request, seq: np.ndarray):
-        """The ONE prefill choreography (admission and recovery replay
-        both route here): reserve the request's full page quota, pad
-        ``seq`` to its bucket, run the compiled prefill (pool donated),
-        and free the reservation before any error surfaces — a leaked
-        reservation drives the engine into permanent backpressure.
-        Returns ``(sampled_token, table)``."""
-        length = len(seq)
-        blocks = self.allocator.alloc(
-            blocks_needed(req.cache_tokens, self.block_size)
-        )
-        if blocks is None:  # admission reserved cumulatively / allocator reset
-            raise RuntimeError("prefill could not reserve its promised pages")
-        req.blocks = blocks
-        bucket = self._bucket(length)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :length] = seq
-        table = np.zeros((self._table_width,), np.int32)
-        table[: len(blocks)] = blocks
-        try:
-            first, self._cache = _prefill(
-                self._params, self._cache, padded, length, req.key, table,
+    def _chunk_bucket(self, n: int) -> int:
+        """Chunk pad length: next power of two from ``min_prefill_bucket``
+        (one compile per bucket), capped at ``prefill_chunk`` — every
+        non-final chunk is exactly ``prefill_chunk`` wide."""
+        b = self.min_prefill_bucket
+        while b < n:
+            b *= 2
+        return min(b, max(self.prefill_chunk, n))
+
+    def _cow_shared_pages(self, req: Request, lo: int, hi: int) -> None:
+        """Copy-on-write every SHARED page the positions ``[lo, hi)``
+        would write: a page with more than one reference (the prefix
+        index's, another stream's) is immutable history — the writer
+        gets a private device-side copy (:func:`.cache.copy_pages`) and
+        the table entry swaps to it.  Never page 0: table rows are real
+        pages or 0, and 0 rows are skipped (their writes steer to trash
+        by construction)."""
+        bs = self.block_size
+        first_blk = lo // bs
+        last_blk = min(-(-hi // bs), self._table_width)
+        for idx in range(first_blk, last_blk):
+            page = int(req.table[idx])
+            if page == 0:
+                continue  # unreserved tail: the scatter steers it to trash
+            if self.allocator.refcount(page) <= 1:
+                continue
+            fresh = self._alloc_pages(1)
+            if fresh is None:
+                raise RuntimeError("copy-on-write could not reserve a page")
+            self._cache = copy_pages(
+                self._cache, np.int32(page), np.int32(fresh[0])
+            )
+            req.table[idx] = fresh[0]
+            req.blocks[req.blocks.index(page)] = fresh[0]
+            self.allocator.free([page])  # drop OUR reference on the shared one
+            self._n_cow += 1
+            _T_COW.add()
+
+    def _run_chunk(self, seq, table, start: int, end: int, key):
+        """Dispatch ONE compiled prefill chunk of ``seq[start:end]``
+        against ``table``.  Returns the sampled first token on the final
+        chunk (``end == len(seq)``), else None."""
+        n = end - start
+        bucket = self._chunk_bucket(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = seq[start:end]
+        pos = np.full((1,), start, np.int32)
+        if end >= len(seq):
+            first, self._cache = _prefill_chunk_last(
+                self._params, self._cache, tokens, pos,
+                np.int32(end - 1 - start), key, table,
                 model=self.model, cfg=self.cfg,
                 temperature=self.temperature, top_k=self.top_k,
-                block_size=self.block_size,
             )
-        except BaseException:
-            self.allocator.free(blocks)
-            req.blocks = None
-            raise
-        return int(first), table
+            return int(first)
+        self._cache = _prefill_chunk(
+            self._params, self._cache, tokens, pos, table,
+            model=self.model, cfg=self.cfg,
+        )
+        return None
 
-    def _prefill_into(self, slot: int, req: Request) -> None:
-        s = len(req.prompt)
+    def _dispatch_chunk(self, slot: int, req: Request, start: int, end: int):
+        """One admission-path chunk: CoW anything the chunk (padding
+        included) would write, then run it."""
+        bucket = self._chunk_bucket(end - start)
+        self._cow_shared_pages(req, start, start + bucket)
         with _telemetry.span(
-            "serve.prefill", slot=slot, prompt_len=s, bucket=self._bucket(s)
+            "serve.prefill", slot=slot, start=start, n=end - start,
+            bucket=bucket, cached=req.n_cached,
         ):
-            first, table = self._prefill_dispatch(req, req.prompt)
+            return self._run_chunk(req.prompt, req.table, start, end, req.key)
+
+    def _complete_prefill(self, slot: int, req: Request, first: int) -> None:
+        """Last chunk done: register the prompt's full pages in the
+        prefix index and install the slot into the decode batch."""
+        if self.prefix is not None and req.hashes:
+            self.prefix.register(
+                req.hashes,
+                [int(req.table[i]) for i in range(len(req.hashes))],
+                self.allocator,
+            )
         req.handle.ttft_s = time.perf_counter() - req.submit_t
         self._ttft.append(req.handle.ttft_s)
         _G_TTFT.set(round(req.handle.ttft_s, 4))
-
-        self._slot_req[slot] = req
+        s = len(req.prompt)
         self._tokens[slot] = first
         self._positions[slot] = s
         self._n_gen[slot] = 1
         self._done[slot] = False
         self._keys[slot] = req.key
-        self._tables[slot] = table
+        self._tables[slot] = req.table
         self._emitted[slot] = 0
         # _push_token retires immediately on a first-token EOS or a
         # budget of one — the slot never enters the decode batch.
         self._push_token(slot, first)
 
-    def _bucket(self, prompt_len: int) -> int:
-        """Prompt pad length: next power of two (one prefill compile per
-        bucket), capped at ``max_model_len``."""
-        b = self.min_prefill_bucket
-        while b < prompt_len:
-            b *= 2
-        return min(b, self.max_model_len)
+    @staticmethod
+    def _reset_prefill_state(req: Request) -> None:
+        """Forget a request's in-progress prefill (its pages are gone —
+        freed or reclaimed by an allocator reset) so a re-admission
+        starts clean.  ``hit_counted`` deliberately survives: the hit
+        rate counts requests, not admission attempts."""
+        req.blocks = None
+        req.table = None
+        req.prefill_pos = 0
+        req.n_cached = 0
+
+    def _abort_prefill(self, slot: int) -> Request:
+        """Back a PREFILLING slot fully out: pages returned (shared ones
+        just drop our reference), chunk state reset, slot idle.  Returns
+        the request, ready to requeue or fail."""
+        req = self._slot_req[slot]
+        if req.blocks:
+            self.allocator.free(req.blocks)
+        self._reset_prefill_state(req)
+        self._clear_slot(slot)
+        return req
+
+    def _on_prefill_failure(self, req: Request, err: BaseException) -> None:
+        """A chunk dispatch raised.  If the donated pool was consumed the
+        supervisor owns everything (prefilling slots requeue, decoding
+        slots replay).  Otherwise charge the failing request's recovery
+        budget and restart its prefill from the FIFO head — together
+        with every prefill admitted behind it, so the failure cannot
+        cost anyone their place in line."""
+        if self._pool_lost():
+            self._supervise_recovery(err)
+            return
+        reqs = [self._abort_prefill(slot) for slot in list(self._prefill_q)]
+        req.recoveries += 1
+        if req.recoveries > self.max_recoveries:
+            _T_RECOVERY_FAILURES.add()
+            req.handle._fail(
+                RecoveryFailed(
+                    f"request {req.rid} aborted: prefill failed "
+                    f"{req.recoveries} times ({err!r})"
+                )
+            )
+            self.scheduler.requeue([r for r in reqs if r is not req])
+        else:
+            _T_PREFILL_RETRIES.add()
+            # ONE requeue call: the failed request lands at the head,
+            # AHEAD of the prefills admitted behind it (two calls would
+            # appendleft the tail in front of it).
+            self.scheduler.requeue(reqs)
+
+    def _prefill_dispatch(self, req: Request, seq: np.ndarray):
+        """Synchronous full-sequence prefill — the recovery replay path
+        (recovery is rare, so no tick interleaving): reserve the
+        request's full page quota, run every chunk back to back, and
+        free the reservation before any error surfaces — a leaked
+        reservation drives the engine into permanent backpressure.
+        Returns ``(sampled_token, table)``.  No prefix-index interaction:
+        replays only run against a freshly-reset pool, where the index
+        is empty by definition."""
+        blocks = self._alloc_pages(
+            blocks_needed(req.cache_tokens, self.block_size)
+        )
+        if blocks is None:  # admission reserved cumulatively / allocator reset
+            raise RuntimeError("prefill could not reserve its promised pages")
+        req.blocks = blocks
+        table = np.zeros((self._table_width,), np.int32)
+        table[: len(blocks)] = blocks
+        try:
+            first = None
+            for start in range(0, len(seq), self.prefill_chunk):
+                end = min(start + self.prefill_chunk, len(seq))
+                first = self._run_chunk(seq, table, start, end, req.key)
+        except BaseException:
+            self.allocator.free(blocks)
+            req.blocks = None
+            raise
+        return first, table
 
     # ------------------------------------------------------------------
     # Decode + the recovery supervisor
 
     def _decode_phase(self) -> None:
-        if not self._n_running():
+        if not self._n_decoding():
             return
         self._decode_no += 1
         try:
@@ -843,7 +1133,7 @@ class Engine:
             return
         sp = _telemetry.start_span(
             "serve.step",
-            n_active=self._n_running(),
+            n_active=self._n_decoding(),
             chunk=self.decode_chunk,
         )
         t0 = time.perf_counter()
@@ -886,7 +1176,9 @@ class Engine:
 
         committed = 0
         for slot, req in enumerate(self._slot_req):
-            if req is None:
+            if req is None or slot in self._prefill_q:
+                # Mid-prefill slots rode the batch as done-slots writing
+                # trash; they have no tokens to commit.
                 continue
             for tok in out[:, slot]:
                 self._push_token(slot, int(tok))
@@ -934,13 +1226,41 @@ class Engine:
             n_live=self._n_running(),
             error=type(error).__name__,
         )
+        for slot in range(self.num_slots):
+            req = self._slot_req[slot]
+            if req is not None:
+                req.recoveries += 1
+        # Slots still PREFILLING have no committed tokens to replay:
+        # their (lost) pages come back with the allocator reset below,
+        # and the requests restart from the FIFO head — in admission
+        # order, within their recovery budgets.  The prefix index dies
+        # with the pool: every cached page's KV is gone.
+        requeue = []
+        for slot in list(self._prefill_q):
+            req = self._slot_req[slot]
+            # No allocator.free here: the lost pool's map is reclaimed
+            # wholesale by the reset below.
+            self._reset_prefill_state(req)
+            if req.recoveries > self.max_recoveries:
+                _T_RECOVERY_FAILURES.add()
+                req.handle._fail(
+                    RecoveryFailed(
+                        f"request {req.rid} aborted: recovery budget "
+                        f"({self.max_recoveries}) exhausted before its "
+                        f"prefill completed ({error!r})"
+                    )
+                )
+            else:
+                requeue.append(req)
+            self._clear_slot(slot)
+        self.scheduler.requeue(requeue)
+        if self.prefix is not None:
+            self.prefix.clear()
         pending = [
             (slot, req)
             for slot, req in enumerate(self._slot_req)
             if req is not None
         ]
-        for _, req in pending:
-            req.recoveries += 1
         while True:
             replayed = 0  # an aborted pass's replays died with its pool
             self.allocator.reset()
@@ -1051,13 +1371,20 @@ class Engine:
         self._n_gen[slot] = 0
         self._done[slot] = True
         self._tables[slot] = 0  # idle slots scribble on the trash page
+        if slot in self._prefill_q:  # reaped/aborted mid-prefill
+            self._prefill_q.remove(slot)
 
     # ------------------------------------------------------------------
     # Introspection
 
     def stats(self) -> dict:
         """Host-side serving stats (TTFT percentiles, sustained decode,
-        lifecycle counts)."""
+        lifecycle counts, prefix-cache effectiveness).
+
+        ``block_utilization`` is PHYSICAL: a page five streams share is
+        one page of HBM and counts once (the refcounted allocator's
+        ``utilization()`` — the same rule behind the ``serve.block_util``
+        gauge)."""
         out = {
             "health": self._health.value,
             "requests": self._next_rid,
@@ -1072,6 +1399,12 @@ class Engine:
             "recoveries": self._n_recoveries,
             "preempted": self._n_preempted,
         }
+        if self.prefix is not None:
+            out["prefix_cached_pages"] = len(self.prefix)
+            out["prefix_hits"] = self.prefix.hits
+            out["prefix_hit_tokens"] = self.prefix.hit_tokens
+            out["prefix_evictions"] = self.prefix.evictions
+            out["cow_copies"] = self._n_cow
         if self._decode_s > 0:
             out["decode_tokens_per_s"] = round(
                 self._decode_tokens / self._decode_s, 1

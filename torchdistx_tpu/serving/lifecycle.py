@@ -118,9 +118,16 @@ class OverloadDetector:
 
     ``max_queue`` bounds waiting requests outright.  ``max_ttft_s``
     bounds the *estimated* time a new arrival would wait for its
-    prefill: the queue must drain ahead of it at
-    ``max_prefills_per_tick`` per tick, so the estimate is
-    ``ceil((depth + 1) / max_prefills_per_tick) * ewma_tick_s``.  The
+    prefill.  The unit of prefill work is the **chunk**, not the
+    request: chunked prefill splits a long prompt's suffix into
+    fixed-size chunks and the engine dispatches at most
+    ``max_prefills_per_tick`` chunks per tick — so a queued 16k-token
+    prompt costs ``ceil(suffix_chunks / max_prefills_per_tick)`` ticks,
+    not 1, and the estimate is
+    ``ceil((queued_chunks + 1) / max_prefills_per_tick) * ewma_tick_s``
+    (the ``+1`` is the arriving request's own first chunk).  Callers
+    that don't chunk (one prompt = one prefill) pass queue depth as the
+    chunk count — the pre-chunking formula is the degenerate case.  The
     tick EWMA is seeded by the first observed tick and smoothed with
     factor ``alpha``; compile-heavy warm-up ticks inflate it briefly and
     decay out (the detector errs toward shedding while cold, which is
@@ -150,18 +157,35 @@ class OverloadDetector:
         else:
             self._tick_ewma_s += self.alpha * (dur_s - self._tick_ewma_s)
 
-    def est_ttft_s(self, queue_depth: int, max_prefills_per_tick: int) -> float:
-        """Estimated wait-for-prefill of a request arriving now."""
+    def est_ttft_s(
+        self, queued_chunks: int, max_prefills_per_tick: int
+    ) -> float:
+        """Estimated wait-for-prefill of a request arriving now.
+
+        ``queued_chunks`` is the total prefill work ahead of the arrival
+        in CHUNKS (``Request.n_chunks`` summed over the queue plus any
+        in-flight prefill's remainder) — an unchunked caller passes
+        queue depth, one chunk per request."""
         if self._tick_ewma_s is None:
             return 0.0
-        ticks = -(-(queue_depth + 1) // max(1, max_prefills_per_tick))
+        ticks = -(-(queued_chunks + 1) // max(1, max_prefills_per_tick))
         return ticks * self._tick_ewma_s
 
-    def overloaded(self, queue_depth: int, max_prefills_per_tick: int) -> bool:
+    def overloaded(
+        self,
+        queue_depth: int,
+        max_prefills_per_tick: int,
+        queued_chunks: Optional[int] = None,
+    ) -> bool:
+        """``max_queue`` bounds REQUESTS (depth); ``max_ttft_s`` bounds
+        estimated prefill wait, which drains in CHUNKS — pass
+        ``queued_chunks`` when they differ (chunked prefill), else depth
+        doubles as the chunk count."""
         if self.max_queue is not None and queue_depth >= self.max_queue:
             return True
         if self.max_ttft_s is not None:
-            if self.est_ttft_s(queue_depth, max_prefills_per_tick) > self.max_ttft_s:
+            chunks = queue_depth if queued_chunks is None else queued_chunks
+            if self.est_ttft_s(chunks, max_prefills_per_tick) > self.max_ttft_s:
                 return True
         return False
 

@@ -34,7 +34,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Iterator, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -60,6 +60,14 @@ class Request:
     submit_t: float = dataclasses.field(default_factory=time.perf_counter)
     blocks: Optional[List[int]] = None  # pages owned while running
     recoveries: int = 0  # replay budget consumed by the supervisor
+    # Chunked-prefill state (owned by the engine while the request holds
+    # a slot in the PREFILLING state):
+    n_chunks: int = 1  # estimated prefill cost in chunks (TTFT estimate)
+    table: Optional[np.ndarray] = None  # (M,) page table being filled
+    prefill_pos: int = 0  # next prompt position to prefill
+    n_cached: int = 0  # prompt tokens served from the prefix cache
+    hashes: Optional[list] = None  # chained full-page hashes of the prompt
+    hit_counted: bool = False  # prefix hit recorded (once per request)
 
     @property
     def cache_tokens(self) -> int:
@@ -148,6 +156,13 @@ class FIFOScheduler:
     def __len__(self) -> int:
         return len(self._waiting)
 
+    def pending_prefill_chunks(self) -> int:
+        """Total prefill cost of the waiting queue, in chunks — the unit
+        the TTFT estimate drains at (``max_prefills_per_tick`` chunks per
+        tick).  A short prompt is one chunk; a 16k prompt behind a small
+        ``prefill_chunk`` is many."""
+        return sum(r.n_chunks for r in self._waiting)
+
     def push(self, req: Request) -> None:
         self._waiting.append(req)
         _G_QUEUE.set(len(self._waiting))
@@ -201,25 +216,38 @@ class FIFOScheduler:
         n_free_slots: int,
         allocator: BlockAllocator,
         block_size: int,
+        reclaim: Optional[Callable[[int], int]] = None,
     ) -> List[Request]:
         """Pop up to ``max_prefills_per_tick`` requests that fit the free
         slots AND whose cumulative page reservations fit the free list.
         Stops at the first head that doesn't fit (FIFO order is the
         fairness guarantee; skipping ahead would starve long prompts).
         Every stalled tick with work waiting counts — whether pages or
-        slots are the binding constraint."""
+        slots are the binding constraint.
+
+        ``reclaim(n)``, when given, is asked to free up to ``n`` more
+        pages before a head is declared unadmittable — the engine wires
+        it to prefix-cache LRU eviction, so cached-but-unreferenced
+        pages never cause an admission stall that an empty cache would
+        not.  The reservation check is conservative (the head's FULL
+        page quota, ignoring any prefix it may share): a cache hit can
+        only admit *no later* than a cache-off engine would."""
         out: List[Request] = []
         limit = min(self.max_prefills_per_tick, n_free_slots)
         if self._waiting and limit == 0:
             _T_BACKPRESSURE.add()  # slot-bound stall, visible like a page-bound one
             return out
-        free_pages = allocator.num_free
+        reserved = 0
         while self._waiting and len(out) < limit:
             need = blocks_needed(self._waiting[0].cache_tokens, block_size)
-            if need > free_pages:
+            avail = allocator.num_free - reserved
+            if need > avail and reclaim is not None:
+                reclaim(need - avail)
+                avail = allocator.num_free - reserved
+            if need > avail:
                 _T_BACKPRESSURE.add()
                 break
-            free_pages -= need
+            reserved += need
             out.append(self._waiting.popleft())
         _G_QUEUE.set(len(self._waiting))
         return out
