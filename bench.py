@@ -508,7 +508,13 @@ def bench_serving():
     block utilization — plus a **prefix-heavy phase**: 80% of requests
     share a 96-token system prompt, run against a cache-off and a
     cache-on engine on the SAME trace (``prefix_hit_rate``,
-    mixed-traffic ``ttft_p95_s`` both ways, the cache's p95 speedup).
+    mixed-traffic ``ttft_p95_s`` both ways, the cache's p95 speedup) —
+    plus a **multi-tenant QoS phase**: a burst tenant's t=0 backlog vs
+    a steady tenant's deadline-bearing higher-priority requests, FIFO
+    and QoS engines paired on the SAME trace, reporting per-tenant
+    TTFT p95, the steady tenant's deadline-hit rate both ways, and the
+    preemption counts (``deadline_hit_improvement`` is the acceptance
+    number — QoS must not lose to FIFO).
     Contrast with ``generate_llama_350m_decode``:
     there the whole batch finishes together and the cache is allocated
     at ``prompt+max_new`` per row; here slots recycle the moment a
@@ -519,7 +525,12 @@ def bench_serving():
 
     from torchdistx_tpu.models import llama
     from torchdistx_tpu.parallel.mesh import make_mesh, MeshSpec
-    from torchdistx_tpu.serving import Engine
+    from torchdistx_tpu.serving import (
+        Engine,
+        init_paged_cache,
+        swap_in_pages,
+        swap_out_pages,
+    )
 
     cfg = llama.LlamaConfig(
         vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
@@ -627,6 +638,125 @@ def bench_serving():
     if off_p95 and on_p95:
         prefix["ttft_p95_speedup"] = round(off_p95 / on_p95, 3)
 
+    # Multi-tenant QoS phase (ISSUE 8): a burst tenant dumping its whole
+    # backlog at t=0 against a steady tenant submitting higher-priority,
+    # deadline-bearing requests — the SAME trace against a FIFO engine
+    # (tenant/priority inert) and a QoS engine (weighted fair queueing +
+    # priority preemption), so per-tenant TTFT p95 and the steady
+    # tenant's deadline-hit rate are a paired comparison.  The deadline
+    # is calibrated from one solo steady-sized request on the warm
+    # engine: generous for a promptly-served request, hopeless behind
+    # the whole burst.
+    mrng = np.random.default_rng(3)
+    n_burst, n_steady = 24, 8
+    b_prompts = [
+        mrng.integers(
+            0, cfg.vocab_size, size=int(mrng.integers(64, 161))
+        ).astype(np.int32)
+        for _ in range(n_burst)
+    ]
+    b_outs = mrng.integers(64, 129, size=n_burst)
+    s_prompts = [
+        mrng.integers(
+            0, cfg.vocab_size, size=int(mrng.integers(32, 65))
+        ).astype(np.int32)
+        for _ in range(n_steady)
+    ]
+    s_outs = mrng.integers(32, 65, size=n_steady)
+    s_arrival = np.arange(n_steady) * 2  # engine ticks between arrivals
+
+    # Warm the preemption programs against the MEASURED pool shape: the
+    # swap gather/scatter jits specialize on (pool shape, page bucket),
+    # so drive them directly on a throwaway pool of the same shape, one
+    # round per power-of-two bucket a victim's private page count can
+    # hit.  A drill engine with a smaller pool would compile for the
+    # wrong shape and the measured QoS run would pay first-preemption
+    # compile stalls out of its deadlines.
+    pool = init_paged_cache(llama, cfg, num_blocks, block_size)
+    bucket = 1
+    while bucket <= max_model_len // block_size:
+        pages = list(range(1, bucket + 1))
+        host = swap_out_pages(pool, pages)
+        pool = swap_in_pages(pool, host, pages)
+        bucket *= 2
+    del pool
+    # A drop-and-replay resume re-prefills prompt + generated-so-far in
+    # one chunk — up to ~288 tokens here, the 512 bucket, which the
+    # 32..192 warm prompts above never reach.
+    warm2 = make_engine()
+    warm2.submit(
+        mrng.integers(0, cfg.vocab_size, size=320).astype(np.int32),
+        max_new_tokens=4, key=0,
+    )
+    warm2.drain()
+
+    cal = make_engine()
+    t0 = time.perf_counter()
+    cal.submit(s_prompts[0], max_new_tokens=int(s_outs[0]), key=0).result()
+    unit_s = time.perf_counter() - t0
+    deadline_s = max(1.0, 8.0 * unit_s)
+
+    def run_multi_tenant(eng):
+        burst_handles = [
+            eng.submit(
+                p, max_new_tokens=int(o), key=100 + i, tenant="burst",
+                priority=0,
+            )
+            for i, (p, o) in enumerate(zip(b_prompts, b_outs))
+        ]
+        steady_handles = []
+        i, tick = 0, 0
+        while i < n_steady or len(eng.scheduler) or eng.stats()["running"]:
+            while i < n_steady and s_arrival[i] <= tick:
+                steady_handles.append(
+                    eng.submit(
+                        s_prompts[i], max_new_tokens=int(s_outs[i]),
+                        key=200 + i, tenant="steady", priority=1,
+                        deadline_s=deadline_s,
+                    )
+                )
+                i += 1
+            eng.step()
+            tick += 1
+        out = {}
+        for tenant, hs in (("burst", burst_handles), ("steady", steady_handles)):
+            ttfts = [h.ttft_s for h in hs if h.ttft_s is not None]
+            row = {"n": len(hs), "completed": sum(h.error is None for h in hs)}
+            if ttfts:
+                row["ttft_p95_s"] = round(float(np.percentile(ttfts, 95)), 4)
+            out[tenant] = row
+        out["steady"]["deadline_hit_rate"] = round(
+            sum(h.error is None for h in steady_handles) / n_steady, 3
+        )
+        st = eng.stats()
+        out["preemptions_swap"] = st.get("preemptions_swap", 0)
+        out["preemptions_replay"] = st.get("preemptions_replay", 0)
+        return out
+
+    multi = {
+        "n_burst": n_burst,
+        "n_steady": n_steady,
+        "steady_deadline_s": round(deadline_s, 3),
+        "fifo": run_multi_tenant(make_engine()),
+        "qos": run_multi_tenant(
+            Engine(
+                params, model=llama, cfg=cfg, num_slots=num_slots,
+                block_size=block_size, num_blocks=num_blocks,
+                max_model_len=max_model_len, decode_chunk=chunk,
+                min_prefill_bucket=32, scheduler="qos",
+                tenant_weights={"steady": 4.0, "burst": 1.0},
+            )
+        ),
+    }
+    # The acceptance number: QoS must not hit FEWER steady deadlines
+    # than FIFO on the same trace (it should hit strictly more under
+    # any real burst).
+    multi["deadline_hit_improvement"] = round(
+        multi["qos"]["steady"]["deadline_hit_rate"]
+        - multi["fifo"]["steady"]["deadline_hit_rate"],
+        3,
+    )
+
     return {
         "n_requests": n_req,
         "num_slots": num_slots,
@@ -641,6 +771,7 @@ def bench_serving():
         "ttft_p95_s": st.get("ttft_p95_s"),
         "peak_block_utilization": round(peak_util, 4),
         "prefix_heavy": prefix,
+        "multi_tenant": multi,
     }
 
 

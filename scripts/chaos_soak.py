@@ -21,6 +21,17 @@ invisible in the stream) or fail typed, and at drain the allocator must
 hold exactly the index's pages with every refcount 1 — zero leaked
 pages, zero stale-refcount pages.
 
+Phase 1.6 — QoS soak (ISSUE 8 acceptance gate): three tenants with
+skewed fair-queueing weights and priority classes over a
+``scheduler="qos"`` prefix-cached engine sized for page pressure.  A
+low-priority wave fills every slot, then a mixed high/low wave (80%
+shared system prompt, deadlines, cancels, ``serve.swap`` io faults
+knocking some swaps back to drop-and-replay) forces preemptions via
+BOTH mechanisms.  Every request must stay token-identical across
+preempt-and-resume or fail typed; at drain: zero leaked pages, zero
+refcount drift, zero phantom swapped pages, and ``serve.preemptions_*``
+visible in the trace.
+
 Phase 2 — drain: under live load, a real SIGTERM goes through the real
 handler chain.  The engine must reach STOPPED within the drain deadline,
 finishing in-flight work or failing it with a retryable typed error —
@@ -297,6 +308,112 @@ def main() -> int:
         f"evictions={st['prefix_evictions']}"
     )
 
+    # ---------------- Phase 1.6: QoS multi-tenant soak ----------------
+    # Three tenants with skewed weights and priority classes over a
+    # QoS-scheduled, prefix-cached engine sized for page pressure: a
+    # low-priority wave occupies every slot first, then a mixed wave
+    # (80% shared system prompt, tiny deadlines, cancels) with
+    # high-priority arrivals forces preemptions — swap-to-host AND
+    # drop-and-replay (serve.swap io faults knock some swaps back to
+    # replay).  The gate: every request token-identical or typed, zero
+    # leaked pages, zero refcount drift, zero phantom swapped pages,
+    # and serve.preemptions_* visible in the trace.
+    faults.reset("")
+    qspecs = [f"serve.swap:{int(s)}:io" for s in rng.integers(1, 5, size=2)]
+    for step in rng.integers(1, N_REQUESTS, size=4):
+        qspecs.append(
+            f"serve.prefill:{int(step)}:{rng.choice(['io', 'nan'])}"
+        )
+    faults.reset(",".join(sorted(set(qspecs))))
+    # 12 usable pages against 4 slots of 4-6-page requests: page
+    # pressure is chronic, so high-priority arrivals must preempt.
+    engq = Engine(
+        params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+        block_size=8, num_blocks=13, max_model_len=64, decode_chunk=4,
+        prefill_chunk=8, max_prefills_per_tick=2, prefix_cache=True,
+        scheduler="qos",
+        tenant_weights={"gold": 8.0, "silver": 2.0, "bronze": 1.0},
+        max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+    )
+    tenants = [("gold", 2), ("silver", 1), ("bronze", 0)]
+    qreqs = []
+    for i in range(8):  # the preemption fodder: slots fill with bronze
+        prompt = rng.integers(
+            0, cfg.vocab_size, size=int(rng.integers(6, 12))
+        ).astype(np.int32)
+        h = engq.submit(
+            prompt, max_new_tokens=24, key=3000 + i, tenant="bronze",
+            priority=0,
+        )
+        qreqs.append((prompt, 24, 3000 + i, h))
+    for _ in range(8):
+        engq.step()
+    system = rng.integers(0, cfg.vocab_size, size=16).astype(np.int32)
+    for i in range(N_REQUESTS):
+        tenant, prio = tenants[int(rng.integers(0, 3))]
+        tail = rng.integers(
+            0, cfg.vocab_size, size=int(rng.integers(2, 20))
+        ).astype(np.int32)
+        prompt = (
+            np.concatenate([system, tail]) if rng.random() < 0.8 else tail
+        )
+        mnt = int(rng.choice(budgets))
+        deadline = None if rng.random() > 0.05 else 1e-6
+        h = engq.submit(
+            prompt, max_new_tokens=mnt, key=3100 + i, deadline_s=deadline,
+            tenant=tenant, priority=prio,
+        )
+        if rng.random() < 0.05:
+            h.cancel()
+        qreqs.append((prompt, mnt, 3100 + i, h))
+
+    for _ in range(MAX_STEPS):
+        if not (len(engq.scheduler) or engq._n_running()):
+            break
+        engq.step()
+    else:
+        return fail(f"QoS soak did not drain within {MAX_STEPS} steps")
+
+    n_ok = n_typed = 0
+    for prompt, mnt, key, h in qreqs:
+        if not h.done:
+            return fail(f"QoS request {key} neither finished nor failed")
+        if h.error is not None:
+            if not isinstance(h.error, RequestError):
+                return fail(f"QoS request {key} failed UNTYPED: {h.error!r}")
+            n_typed += 1
+        else:
+            if h.result() != solo(prompt, key, mnt):
+                return fail(
+                    f"QoS request {key} diverged from solo generate() "
+                    "(preempt/resume broke token identity)"
+                )
+            n_ok += 1
+    qst = engq.stats()
+    if qst["preemptions_swap"] + qst["preemptions_replay"] < 1:
+        return fail("QoS soak produced no preemptions — pressure too soft")
+    if qst["swapped_pages"] != 0 or engq.allocator.num_swapped != 0:
+        return fail(
+            f"QoS soak left {engq.allocator.num_swapped} phantom "
+            "swapped pages"
+        )
+    if engq.allocator.num_in_use != len(engq.prefix):
+        return fail(
+            f"QoS soak leaked pages: {engq.allocator.num_in_use} in use "
+            f"vs {len(engq.prefix)} indexed"
+        )
+    drift = engq.prefix.check(engq.allocator)
+    if drift is not None:
+        return fail(f"QoS soak refcount drift: {drift}")
+    engq.prefix.release(engq.allocator)
+    if engq.allocator.num_in_use != 0:
+        return fail("QoS prefix release left pages owned")
+    print(
+        f"chaos_soak: QoS soak OK — {n_ok} token-identical, {n_typed} "
+        f"typed failures, preempt_swap={qst['preemptions_swap']}, "
+        f"preempt_replay={qst['preemptions_replay']}"
+    )
+
     # ---------------- Phase 2: SIGTERM drain under load ----------------
     faults.reset("")
     eng_mod._decode_chunk = real_decode
@@ -355,6 +472,15 @@ def main() -> int:
         return fail(
             "trace shows no serve.prefix_hits — the prefix-heavy phase "
             "left no mark"
+        )
+    if (
+        counters.get("serve.preemptions_swap", 0)
+        + counters.get("serve.preemptions_replay", 0)
+        < 1
+    ):
+        return fail(
+            "trace shows no serve.preemptions_* — the QoS phase left "
+            "no mark"
         )
     print(
         "chaos_soak: trace OK — recoveries="

@@ -442,6 +442,65 @@ def test_hot_swap_zero_drop_under_load(family):
 
 
 # ---------------------------------------------------------------------------
+# QoS context propagation (ISSUE 8)
+
+
+def test_failover_forwards_qos_context(family):
+    """A request's QoS context — tenant, priority, deadline — must ride
+    EVERY fleet re-submission: a stream preempted on one replica and
+    failed over to another keeps its class there.  Pinned two ways: the
+    bound engine's queued Request carries the context verbatim, and
+    after a failover the peer's QoS engine *acts* on the forwarded
+    priority (it preempts its own low-priority stream for the
+    newcomer)."""
+    model, cfg, params = family
+
+    def qos_engine():
+        return make_engine(
+            family, scheduler="qos", num_slots=1, decode_chunk=4,
+        )
+
+    eng_a, eng_b = qos_engine(), qos_engine()
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=3)
+    # Occupy both engines' single slot with low-priority local streams.
+    a_local = eng_a.submit(prompt_of(6), max_new_tokens=30, key=50,
+                           priority=0)
+    eng_a.step()
+    b_local = eng_b.submit(prompt_of(6, base=2), max_new_tokens=30, key=51,
+                           priority=0)
+    eng_b.step()
+    # Deterministic routing: equal estimates and load -> replica 0 (A).
+    eng_a.detector._tick_ewma_s = eng_b.detector._tick_ewma_s = None
+    h = router.submit(
+        prompt_of(5, base=4), max_new_tokens=6, key=52, deadline_s=60.0,
+        tenant="gold", priority=3,
+    )
+    assert h.replica_id == 0
+    queued = eng_a.scheduler.peek()
+    assert queued.tenant == "gold" and queued.priority == 3
+    assert queued.deadline is not None
+    # Kill A: the queued request fails retryably and must re-place on B
+    # with its context intact — proven by B's QoS engine PREEMPTING its
+    # low-priority stream for the forwarded priority-3 arrival.
+    before = telemetry.counter("serve.preemptions_replay").value
+    eng_a.close()
+    assert h.result() == solo(
+        model, cfg, params, prompt_of(5, base=4), 52, 6
+    )
+    assert h.replica_id == 1 and h.tenant == "gold" and h.priority == 3
+    assert telemetry.counter("serve.preemptions_replay").value > before
+    # A's local stream died with its engine (typed, retryable)...
+    assert isinstance(a_local.error, RequestError) and a_local.error.retryable
+    # ...and B's preempted local stream resumed token-identically.
+    eng_b.drain()
+    assert b_local.result() == solo(
+        model, cfg, params, prompt_of(6, base=2), 51, 30
+    )
+    assert eng_b.allocator.num_in_use == 0
+    assert eng_b.allocator.num_swapped == 0
+
+
+# ---------------------------------------------------------------------------
 # Mini fleet chaos (the CI-scale soak lives in scripts/chaos_soak.py)
 
 

@@ -1,0 +1,656 @@
+"""QoS subsystem (ISSUE 8): SLO-aware multi-tenant scheduling with
+priority preemption and KV swap-to-host.
+
+The acceptance bar: preempted-and-resumed streams are token-identical
+to solo ``generate()`` via BOTH mechanisms (swap-in and
+drop-and-replay), greedy and sampled, prefix cache on and off; the
+weighted-fair-queueing starvation bound is provable and pinned; a
+high-priority arrival preempts low-priority decode within one tick;
+and ``scheduler="fifo"`` (the default) leaves every existing behavior
+byte-identical — which the unchanged test_serving*.py suites pin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.models.generate import generate
+from torchdistx_tpu.resilience import faults, preemption
+from torchdistx_tpu.serving import (
+    BlockAllocator,
+    Engine,
+    EngineOverloaded,
+    QoSScheduler,
+    RequestCancelled,
+    RequestPreempted,
+)
+from torchdistx_tpu.serving.scheduler import Request, RequestHandle
+
+EOS = 5
+ENGINE_KW = dict(num_slots=2, block_size=8, max_model_len=64, decode_chunk=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    preemption.clear()
+    yield
+    preemption.clear()
+    faults.reset("")
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def solo(model, cfg, params, prompt, seed, max_new, *, eos=None,
+         temperature=0.0, top_k=None):
+    out = generate(
+        params, jnp.asarray(prompt)[None], jax.random.PRNGKey(seed),
+        model=model, cfg=cfg, max_new_tokens=max_new, eos_id=eos,
+        temperature=temperature, top_k=top_k,
+    )
+    toks = [int(t) for t in np.asarray(out)[0]]
+    if eos is not None and eos in toks:
+        toks = toks[: toks.index(eos) + 1]
+    return toks
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def req_of(rid, *, tenant="default", priority=0, n_chunks=1, deadline=None):
+    """A bare waiting Request for scheduler-level tests."""
+    return Request(
+        rid, np.zeros(4, np.int32), 4, np.zeros(2, np.uint32),
+        RequestHandle(None, rid), deadline=deadline, n_chunks=n_chunks,
+        tenant=tenant, priority=priority,
+    )
+
+
+def pop_order(sched, n, *, num_blocks=4096, block_size=8):
+    """Drain ``n`` pops one at a time; returns the request ids."""
+    alloc = BlockAllocator(num_blocks, block_size)
+    out = []
+    for _ in range(n):
+        got = sched.pop_admissible(1, alloc, block_size)
+        assert len(got) == 1, "scheduler stalled with work waiting"
+        alloc.free(got[0].blocks) if got[0].blocks else None
+        alloc.reset()  # pages are irrelevant to these ordering tests
+        out.append(got[0].rid)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# QoSScheduler: ordering, fairness, starvation bounds (host-side only)
+
+
+def test_wfq_starvation_bound_weight_8_vs_1():
+    """The provable bound: under a sustained weight-8 backlog, a
+    weight-1 tenant's consecutive one-chunk requests are separated by
+    at most weight_ratio (8) competing chunks — it always progresses."""
+    sched = QoSScheduler(tenant_weights={"whale": 8.0, "minnow": 1.0})
+    for i in range(30):
+        sched.push(req_of(i, tenant="whale"))
+    sched.push(req_of(100, tenant="minnow"))
+    sched.push(req_of(101, tenant="minnow"))
+    order = pop_order(sched, 32)
+    first, second = order.index(100), order.index(101)
+    # Between the minnow's two admissions: at most 8 whale requests
+    # (the weight ratio), so gap <= 9 positions.
+    assert second - first <= 9, order
+    # And the whale got the bulk of the early service: weights mean
+    # shares, not strict alternation.
+    assert sum(r < 30 for r in order[:second]) >= second - 2, order
+
+
+def test_wfq_idle_tenant_banks_no_credit():
+    """A tenant that slept while another was served does not return
+    with a huge vtime deficit and lock the queue: its virtual time is
+    clamped up to the clock on re-arrival."""
+    sched = QoSScheduler()
+    for i in range(10):
+        sched.push(req_of(i, tenant="busy"))
+    assert pop_order(sched, 10) == list(range(10))
+    # 'busy' served 10 chunks while 'sleeper' was idle.  Now both push.
+    for i in range(4):
+        sched.push(req_of(20 + i, tenant="busy"))
+        sched.push(req_of(30 + i, tenant="sleeper"))
+    order = pop_order(sched, 8)
+    # Fair interleave from here on — the sleeper gets no 10-chunk
+    # catch-up binge (no more than 2 consecutive sleeper pops).
+    assert order[:2] != [30, 31] or order[2] == 20, order
+    assert sum(r >= 30 for r in order[:4]) == 2, order
+
+
+def test_vclock_scoped_per_class():
+    """Service in one class must not move another class's virtual
+    clock: a fresh high-class tenant's pop (virtual time 0) may not
+    regress the clock a busy lower class's newcomers clamp to — that
+    would hand them a head start over the class's backlogged
+    incumbents, breaking the w/W bound."""
+    sched = QoSScheduler()
+    for i in range(10):
+        sched.push(req_of(i, tenant="a", priority=0))
+    assert pop_order(sched, 4) == [0, 1, 2, 3]  # a's class-0 vt climbs
+    # A fresh tenant pops in class 1 at virtual time 0, while class 0
+    # stays backlogged.
+    sched.push(req_of(20, tenant="c", priority=1))
+    assert pop_order(sched, 1) == [20]
+    # Tenant b joins class 0: it clamps to CLASS 0's clock (a's
+    # neighborhood), not the class-1 pop's — fair interleave, no
+    # b-monopoly burning up from 0.
+    for i in range(4):
+        sched.push(req_of(30 + i, tenant="b", priority=0))
+    order = pop_order(sched, 8)
+    assert sum(r >= 30 for r in order[:4]) == 2, order
+
+
+def test_tenant_state_pruned_when_idle():
+    """Scheduler state must track WAITING work, not tenants ever seen:
+    free-form per-user tenant ids on a long-lived engine would
+    otherwise grow the vt map, counters, gauge iteration, and empty
+    heaps without bound.  A class that empties resets its virtual time
+    wholesale (the classic busy-period rule)."""
+    sched = QoSScheduler()
+    for i in range(6):
+        sched.push(req_of(i, tenant=f"user-{i}", priority=i % 2))
+    assert len(pop_order(sched, 6)) == 6
+    assert sched._tenant_n == {}
+    assert sched._tenant_gauges == {}
+    assert sched._vt == {} and sched._vclock == {}
+    assert sched._queues == {}
+
+
+def test_priority_classes_strict_and_edf_within():
+    """Higher classes drain first regardless of tenant vtime; within a
+    (class, tenant) queue, earliest deadline first, deadline-less
+    requests after, ties by submission order."""
+    sched = QoSScheduler()
+    sched.push(req_of(0, priority=0))
+    sched.push(req_of(1, priority=1, deadline=500.0))
+    sched.push(req_of(2, priority=1))  # no deadline: after the dated ones
+    sched.push(req_of(3, priority=1, deadline=100.0))
+    sched.push(req_of(4, priority=2))
+    assert pop_order(sched, 5) == [4, 3, 1, 2, 0]
+
+
+def test_requeue_returns_head_of_line_without_recharge():
+    """A transiently-failed admission batch requeues ahead of the QoS
+    order (transactional retry) and is not charged a second fare."""
+    sched = QoSScheduler(tenant_weights={"a": 1.0, "b": 1.0})
+    sched.push(req_of(0, tenant="a", n_chunks=4))
+    sched.push(req_of(1, tenant="b"))
+    alloc = BlockAllocator(4096, 8)
+    got = sched.pop_admissible(1, alloc, 8)
+    assert [r.rid for r in got] == [0]
+    vt_after_pop = dict(sched._vt)
+    sched.requeue(got)
+    assert sched.peek().rid == 0  # head of line again, ahead of b
+    sched.pop_admissible(1, alloc, 8)
+    assert sched._vt == vt_after_pop  # no second fare for tenant a
+
+
+def test_shed_hooks_oldest_and_lowest():
+    sched = QoSScheduler()
+    sched.push(req_of(0, priority=1))
+    sched.push(req_of(1, priority=0))
+    sched.push(req_of(2, priority=0))
+    # by-priority victim: lowest class, youngest first...
+    victim = sched.shed_lowest(below_priority=1)
+    assert victim.rid == 2
+    # ...and None when nothing sits strictly below the arrival's class.
+    assert sched.shed_lowest(below_priority=0) is None
+    # drop-oldest compatibility: globally oldest by submission.
+    assert sched.shed_oldest().rid == 0
+    assert sched.shed_oldest().rid == 1
+    assert sched.shed_oldest() is None
+    assert len(sched) == 0
+
+
+def test_purge_and_flush_cover_all_queues():
+    sched = QoSScheduler()
+    r_ok = req_of(0, priority=1)
+    r_cancel = req_of(1, priority=0)
+    r_expired = req_of(2, priority=2, deadline=-1.0)
+    for r in (r_ok, r_cancel, r_expired):
+        sched.push(r)
+    r_cancel.handle._cancel_requested = True
+    expired, cancelled = sched.purge(now=0.0)
+    assert [r.rid for r in expired] == [2]
+    assert [r.rid for r in cancelled] == [1]
+    assert len(sched) == 1 and sched.peek() is r_ok
+    assert sched.pending_prefill_chunks() == 1
+    assert [r.rid for r in sched.flush()] == [0]
+    assert len(sched) == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine + QoS: token parity, preemption via both mechanisms
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_engine_qos_token_identical_plain(family, sampled):
+    """QoS-scheduled traffic with mixed tenants/priorities but no
+    pressure: every stream equals its solo generate() run."""
+    model, cfg, params = family
+    sample_kw = dict(temperature=0.8, top_k=20) if sampled else {}
+    eng = Engine(
+        params, model=model, cfg=cfg, eos_id=EOS, scheduler="qos",
+        tenant_weights={"gold": 4.0}, **sample_kw, **ENGINE_KW,
+    )
+    reqs = [
+        (prompt_of(5 + i, base=i + 1), 6 + (i % 2) * 3, i) for i in range(5)
+    ]
+    handles = [
+        eng.submit(
+            p, max_new_tokens=m, key=600 + seed,
+            tenant=("gold" if seed % 2 else "free"), priority=seed % 3,
+        )
+        for p, m, seed in reqs
+    ]
+    eng.drain()
+    for (p, m, seed), h in zip(reqs, handles):
+        assert h.result() == solo(
+            model, cfg, params, p, 600 + seed, m, eos=EOS, **sample_kw
+        ), f"request {seed}"
+    assert eng.allocator.num_in_use == 0
+    assert eng.allocator.num_swapped == 0
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+@pytest.mark.parametrize("cache_on", [False, True])
+def test_preempt_drop_and_replay_token_identical(family, sampled, cache_on):
+    """Slot pressure: a high-priority arrival drop-and-replay-preempts
+    the low-priority decoding stream within one tick; the victim
+    resumes by re-prefilling prompt + generated-so-far and both streams
+    equal solo generate() — greedy and sampled, cache on and off."""
+    model, cfg, params = family
+    sample_kw = dict(temperature=0.8, top_k=20) if sampled else {}
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", num_slots=1,
+        block_size=8, max_model_len=64, decode_chunk=4,
+        prefix_cache=cache_on, **sample_kw,
+    )
+    victim = eng.submit(
+        prompt_of(6), max_new_tokens=24, key=700, priority=0
+    )
+    eng.step()
+    assert not victim.done and len(victim._tokens) > 0
+    urgent = eng.submit(
+        prompt_of(6, base=3), max_new_tokens=8, key=701, priority=5
+    )
+    before = telemetry.counter("serve.preemptions_replay").value
+    eng.step()  # ONE tick: victim out, urgent prefilled into the slot
+    assert telemetry.counter("serve.preemptions_replay").value == before + 1
+    assert urgent.ttft_s is not None, "high-pri arrival waited out the victim"
+    assert eng._slot_req[0] is None or eng._slot_req[0].rid == urgent.rid
+    eng.drain()
+    assert urgent.result() == solo(
+        model, cfg, params, prompt_of(6, base=3), 701, 8, **sample_kw
+    )
+    assert victim.result() == solo(
+        model, cfg, params, prompt_of(6), 700, 24, **sample_kw
+    ), "drop-and-replay resume diverged"
+    assert eng.stats()["preemptions_replay"] >= 1
+    assert eng.allocator.num_in_use == (
+        len(eng.prefix) if cache_on else 0
+    )
+    assert eng.allocator.num_swapped == 0
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+@pytest.mark.parametrize("cache_on", [False, True])
+def test_preempt_swap_to_host_token_identical(family, sampled, cache_on):
+    """Page pressure with a free slot: the low-priority stream's pages
+    swap to host (slot parks out of the decode batch), the
+    high-priority request runs, and the victim swaps back in once
+    pressure drops — token-identical throughout.  With the prefix cache
+    on, both prompts are identical, so the swap covers shared
+    (refcounted) pages and the resume covers re-privatized ones."""
+    model, cfg, params = family
+    sample_kw = dict(temperature=0.8, top_k=20) if sampled else {}
+    # 8 usable pages; each request reserves 5 (8 prompt + 26 out = 34
+    # tokens / 8) — two can never both hold pages.
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", num_slots=2,
+        block_size=8, num_blocks=9, max_model_len=64, decode_chunk=4,
+        prefix_cache=cache_on, **sample_kw,
+    )
+    prompt_a = prompt_of(8)
+    prompt_b = prompt_of(8) if cache_on else prompt_of(8, base=2)
+    victim = eng.submit(prompt_a, max_new_tokens=26, key=800, priority=0)
+    eng.step()
+    assert not victim.done
+    urgent = eng.submit(prompt_b, max_new_tokens=26, key=801, priority=5)
+    before = telemetry.counter("serve.preemptions_swap").value
+    eng.step()  # ONE tick: victim swapped out, urgent admitted
+    assert telemetry.counter("serve.preemptions_swap").value == before + 1
+    assert eng.allocator.num_swapped > 0
+    assert eng.stats()["swapped_pages"] > 0
+    if cache_on:
+        # The victim's index-shared prompt page stays MAPPED on the
+        # refs it keeps (swapping a shared page would free nothing and
+        # duplicate it at swap-in): only the 4 private pages of its
+        # 5-page reservation are host-resident.
+        assert eng.allocator.num_swapped == 4
+    eng.drain()
+    assert urgent.result() == solo(
+        model, cfg, params, prompt_b, 801, 26, **sample_kw
+    )
+    assert victim.result() == solo(
+        model, cfg, params, prompt_a, 800, 26, **sample_kw
+    ), "swap-in resume diverged"
+    st = eng.stats()
+    assert st["preemptions_swap"] >= 1 and st["swapped_pages"] == 0
+    assert eng.allocator.num_swapped == 0
+    assert eng.allocator.num_in_use == (
+        len(eng.prefix) if cache_on else 0
+    )
+    if cache_on:
+        assert eng.prefix.check(eng.allocator) is None
+
+
+def test_preempt_mechanism_replay_under_page_pressure(family):
+    """preempt_mechanism='replay' serves page pressure with
+    drop-and-replay instead of swap — same token identity."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", num_slots=2,
+        block_size=8, num_blocks=9, max_model_len=64, decode_chunk=4,
+        preempt_mechanism="replay",
+    )
+    victim = eng.submit(prompt_of(8), max_new_tokens=26, key=810, priority=0)
+    eng.step()
+    urgent = eng.submit(
+        prompt_of(8, base=2), max_new_tokens=26, key=811, priority=5
+    )
+    eng.drain()
+    assert urgent.result() == solo(
+        model, cfg, params, prompt_of(8, base=2), 811, 26
+    )
+    assert victim.result() == solo(model, cfg, params, prompt_of(8), 810, 26)
+    st = eng.stats()
+    assert st["preemptions_replay"] >= 1 and st["preemptions_swap"] == 0
+    assert eng.allocator.num_in_use == 0
+
+
+def test_swap_fault_falls_back_to_drop_and_replay(family):
+    """TDX_FAULT serve.swap:io fails the host gather mid-preemption:
+    device state is untouched (the gather is read-only) and the
+    preemption falls back to drop-and-replay — still token-identical,
+    counted as a replay, not a swap."""
+    model, cfg, params = family
+    faults.reset("serve.swap:1:io")
+    fired_before = telemetry.counter("faults.fired").value
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", num_slots=2,
+        block_size=8, num_blocks=9, max_model_len=64, decode_chunk=4,
+    )
+    victim = eng.submit(prompt_of(8), max_new_tokens=26, key=820, priority=0)
+    eng.step()
+    urgent = eng.submit(
+        prompt_of(8, base=2), max_new_tokens=26, key=821, priority=5
+    )
+    eng.drain()
+    assert telemetry.counter("faults.fired").value == fired_before + 1
+    st = eng.stats()
+    assert st["preemptions_swap"] == 0 and st["preemptions_replay"] >= 1
+    assert victim.result() == solo(model, cfg, params, prompt_of(8), 820, 26)
+    assert urgent.result() == solo(
+        model, cfg, params, prompt_of(8, base=2), 821, 26
+    )
+    assert eng.allocator.num_in_use == 0 and eng.allocator.num_swapped == 0
+
+
+@pytest.mark.parametrize("sampled", [False, True])
+def test_burst_tenant_does_not_starve_weighted_peer(family, sampled):
+    """A weight-1 burst tenant flooding the queue cannot make a
+    weight-8 steady tenant wait out the whole burst: fair queueing
+    admits the steady request after at most a couple of burst ones."""
+    model, cfg, params = family
+    sample_kw = dict(temperature=0.8, top_k=20) if sampled else {}
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos",
+        tenant_weights={"steady": 8.0, "burst": 1.0},
+        **sample_kw, **ENGINE_KW,
+    )
+    burst = [
+        eng.submit(
+            prompt_of(5, base=i + 1), max_new_tokens=12, key=900 + i,
+            tenant="burst",
+        )
+        for i in range(6)
+    ]
+    steady = eng.submit(
+        prompt_of(5, base=9), max_new_tokens=12, key=950, tenant="steady"
+    )
+    ticks = 0
+    while steady.ttft_s is None:
+        eng.step()
+        ticks += 1
+        assert ticks < 200, "steady tenant starved"
+    # At most the 2 burst requests that grabbed the slots first (plus
+    # one more finishing) beat the steady tenant to a first token.
+    assert sum(h.ttft_s is not None for h in burst) <= 3
+    eng.drain()
+    assert steady.result() == solo(
+        model, cfg, params, prompt_of(5, base=9), 950, 12, **sample_kw
+    )
+    for i, h in enumerate(burst):
+        assert h.result() == solo(
+            model, cfg, params, prompt_of(5, base=i + 1), 900 + i, 12,
+            **sample_kw,
+        )
+    assert eng.allocator.num_in_use == 0
+
+
+def test_cache_aware_admission_cost(family):
+    """A prefix-cache hit shrinks a request's fair-queueing cost and
+    TTFT weight to its SUFFIX chunks: the second identical prompt
+    weighs 1 chunk, not its full length, and the WFQ fare charged to
+    its tenant shrinks accordingly."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", prefix_cache=True,
+        prefill_chunk=4, min_prefill_bucket=4, **ENGINE_KW,
+    )
+    prompt = prompt_of(16)  # 2 full pages; 4 chunks of 4 uncached
+    h1 = eng.submit(prompt, max_new_tokens=4, key=990, tenant="a")
+    assert eng.scheduler.peek().n_chunks == 4
+    eng.drain()
+    assert eng.stats()["prefix_cached_pages"] == 2
+    # Same prompt again: probe() sees the cached pages, the suffix is
+    # the single recomputed last token -> 1 chunk.
+    h2 = eng.submit(prompt, max_new_tokens=4, key=991, tenant="a")
+    assert eng.scheduler.peek().n_chunks == 1
+    # A second tenant keeps the class busy across h2's admission, so
+    # its WFQ charge is observable (an emptied class resets its
+    # virtual time wholesale).
+    h3 = eng.submit(prompt_of(4, base=9), max_new_tokens=4, key=992,
+                    tenant="b")
+    eng.step()  # admits h2 (tenant a pops first on the vt tie)
+    assert eng.scheduler._vt[(0, "a")] == pytest.approx(1.0), (
+        "WFQ charged the cached request more than its suffix"
+    )
+    eng.drain()
+    assert h1.result() == solo(model, cfg, params, prompt, 990, 4)
+    assert h2.result() == solo(model, cfg, params, prompt, 991, 4)
+    assert h3.result() == solo(model, cfg, params, prompt_of(4, base=9), 992, 4)
+    eng.prefix.release(eng.allocator)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_preempt_requeue_cost_is_cache_aware(family):
+    """A drop-and-replay victim's requeue fare must weigh only the
+    suffix its re-prefill will actually dispatch: the index still
+    holds its prompt pages, so re-admission maps them again and the
+    replay is generated-so-far only — not prompt + generated."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", num_slots=1,
+        block_size=8, max_model_len=64, decode_chunk=4, prefill_chunk=4,
+        min_prefill_bucket=4, prefix_cache=True,
+    )
+    victim = eng.submit(prompt_of(8), max_new_tokens=16, key=860, priority=0)
+    while victim.ttft_s is None:
+        eng.step()
+    eng.step()  # one decode chunk: 4 more committed tokens
+    urgent = eng.submit(prompt_of(8, base=2), max_new_tokens=4, key=861,
+                        priority=5)
+    eng.step()  # slot pressure: victim drop-and-replay preempted
+    assert eng.stats()["preemptions_replay"] >= 1
+    queued = eng.scheduler.peek()
+    assert queued is not None and queued.rid == 0
+    # The prompt's full page (8 tokens) is still indexed, so the fare
+    # weighs only the generated-so-far suffix the re-prefill will
+    # actually dispatch — not the whole prompt + generated sequence.
+    replay_len = queued.replay_len()
+    assert replay_len > 8  # tokens were committed before the preempt
+    suffix_chunks = -(-(replay_len - 8) // 4)
+    full_chunks = -(-replay_len // 4)
+    assert suffix_chunks < full_chunks
+    assert queued.n_chunks == suffix_chunks, (
+        "requeue fare ignored the prefix cache"
+    )
+    eng.drain()
+    assert urgent.result() == solo(
+        model, cfg, params, prompt_of(8, base=2), 861, 4
+    )
+    assert victim.result() == solo(model, cfg, params, prompt_of(8), 860, 16)
+    eng.prefix.release(eng.allocator)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_shed_by_priority_policy(family):
+    """shed_policy='by-priority': the overload victim is the lowest
+    class, youngest first — and an arrival that is itself the lowest
+    class is the one rejected."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", max_queue=2,
+        shed_policy="by-priority", num_slots=1, block_size=8,
+        max_model_len=64, decode_chunk=4,
+    )
+    blocker = eng.submit(prompt_of(6), max_new_tokens=30, key=0, priority=9)
+    eng.step()  # occupies the only slot: the queue backs up
+    low_old = eng.submit(prompt_of(4, base=1), max_new_tokens=4, key=1,
+                         priority=0)
+    low_young = eng.submit(prompt_of(4, base=2), max_new_tokens=4, key=2,
+                           priority=0)
+    # Queue full (2).  A higher-class arrival sheds the YOUNGEST of the
+    # LOWEST class — not the oldest request.
+    high = eng.submit(prompt_of(4, base=3), max_new_tokens=4, key=3,
+                      priority=1)
+    assert low_young.done and isinstance(low_young.error, EngineOverloaded)
+    assert not low_old.done
+    # An arrival that is itself the lowest class is the one shed.
+    with pytest.raises(EngineOverloaded):
+        eng.submit(prompt_of(4, base=4), max_new_tokens=4, key=4, priority=0)
+    blocker.cancel()
+    eng.drain()
+    assert high.result() == solo(model, cfg, params, prompt_of(4, base=3), 3, 4)
+    assert low_old.result() == solo(
+        model, cfg, params, prompt_of(4, base=1), 1, 4
+    )
+    assert eng.allocator.num_in_use == 0
+
+
+def test_shed_by_priority_empty_queue_admits(family):
+    """An overloaded engine whose WAITING queue is empty (pressure is
+    all in-flight work) must not reject a high-priority arrival under
+    shed_policy='by-priority': with no waiting class to compare
+    against, the arrival is admitted — and preemption, not shedding,
+    resolves the pressure."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos",
+        shed_policy="by-priority", max_ttft_s=1e-9, num_slots=1,
+        block_size=8, max_model_len=64, decode_chunk=4,
+    )
+    low = eng.submit(prompt_of(6), max_new_tokens=30, key=0, priority=0)
+    eng.step()
+    eng.step()  # ticks recorded: est_ttft_s now trips max_ttft_s
+    assert eng.est_ttft_s() > 1e-9 and not len(eng.scheduler)
+    shed_before = eng.stats()["shed"]
+    high = eng.submit(prompt_of(4, base=3), max_new_tokens=4, key=1,
+                      priority=5)
+    assert eng.stats()["shed"] == shed_before  # nothing waiting was shed
+    eng.drain()
+    assert high.result() == solo(model, cfg, params, prompt_of(4, base=3), 1, 4)
+    assert low.result() == solo(model, cfg, params, prompt_of(6), 0, 30)
+    assert eng.allocator.num_in_use == 0
+
+
+def test_swapped_slot_cancel_settles_accounts(family):
+    """Cancelling a swapped-out stream discards its host buffer and
+    settles the allocator's swap account — no leaked pages, no phantom
+    swapped count."""
+    model, cfg, params = family
+    eng = Engine(
+        params, model=model, cfg=cfg, scheduler="qos", num_slots=2,
+        block_size=8, num_blocks=9, max_model_len=64, decode_chunk=4,
+    )
+    victim = eng.submit(prompt_of(8), max_new_tokens=26, key=830, priority=0)
+    eng.step()
+    urgent = eng.submit(
+        prompt_of(8, base=2), max_new_tokens=26, key=831, priority=5
+    )
+    eng.step()
+    assert eng.allocator.num_swapped > 0
+    victim.cancel()
+    eng.step()  # next chunk boundary: the swapped victim leaves
+    assert victim.done and isinstance(victim.error, RequestCancelled)
+    assert eng.allocator.num_swapped == 0
+    eng.drain()
+    assert urgent.result() == solo(
+        model, cfg, params, prompt_of(8, base=2), 831, 26
+    )
+    assert eng.allocator.num_in_use == 0
+
+
+def test_preempted_resumable_flag(family):
+    """RequestPreempted.resumable: True for a request that never
+    yielded a token (a plain resubmit resumes it losslessly), False
+    for a mid-stream cut (a lossless resume needs a key-pinned
+    replay)."""
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, drain_deadline_s=0.0,
+                 **ENGINE_KW)
+    running = eng.submit(prompt_of(6), max_new_tokens=30, key=0)
+    eng.step()
+    assert len(running._tokens) > 0
+    waiting = eng.submit(prompt_of(5), max_new_tokens=4, key=1)
+    preemption.request()
+    eng.step()  # drain begins; deadline 0 cuts the running stream now
+    assert isinstance(waiting.error, RequestPreempted)
+    assert waiting.error.resumable  # flushed before prefill: resubmit = resume
+    assert isinstance(running.error, RequestPreempted)
+    assert not running.error.resumable  # mid-stream: needs a pinned replay
+    assert eng.allocator.num_in_use == 0
+
+
+def test_qos_knob_validation(family):
+    model, cfg, params = family
+    with pytest.raises(ValueError, match="scheduler"):
+        Engine(params, model=model, cfg=cfg, scheduler="lifo", **ENGINE_KW)
+    with pytest.raises(ValueError, match="tenant_weights"):
+        Engine(params, model=model, cfg=cfg, tenant_weights={"a": 2.0},
+               **ENGINE_KW)
+    with pytest.raises(ValueError, match="by-priority"):
+        Engine(params, model=model, cfg=cfg, shed_policy="by-priority",
+               **ENGINE_KW)
+    with pytest.raises(ValueError, match="preempt_mechanism"):
+        Engine(params, model=model, cfg=cfg, preempt_mechanism="dropall",
+               **ENGINE_KW)
+    with pytest.raises(ValueError, match="weights must be > 0"):
+        QoSScheduler(tenant_weights={"a": 0.0})
+    eng = Engine(params, model=model, cfg=cfg, scheduler="qos", **ENGINE_KW)
+    with pytest.raises(ValueError, match="tenant"):
+        eng.submit(prompt_of(4), max_new_tokens=2, key=0, tenant="")

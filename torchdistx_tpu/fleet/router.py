@@ -144,11 +144,19 @@ class FleetHandle:
         key,
         deadline_s: Optional[float],
         max_hops: int,
+        tenant: str = "default",
+        priority: int = 0,
     ):
         self._router = router
         self._prompt = np.asarray(prompt, np.int32).reshape(-1)
         self._max_new_tokens = int(max_new_tokens)
         self._key = key
+        # QoS context: pinned at fleet submission and forwarded on
+        # EVERY re-submission, so a preempted-then-failed-over stream
+        # keeps its class, tenant share, and remaining deadline on the
+        # peer (inert on FIFO-scheduled engines).
+        self.tenant = str(tenant)
+        self.priority = int(priority)
         self._deadline = (
             time.perf_counter() + deadline_s if deadline_s is not None else None
         )
@@ -249,6 +257,8 @@ class FleetHandle:
                     max_new_tokens=self._max_new_tokens,
                     key=self._key,
                     deadline_s=self._remaining_deadline_s(),
+                    tenant=self.tenant,
+                    priority=self.priority,
                 )
             except RequestError as err:
                 if not retry.is_retryable(err):
@@ -485,6 +495,8 @@ class FleetRouter:
         key: Any = None,
         deadline_s: Optional[float] = None,
         max_hops: Optional[int] = None,
+        tenant: str = "default",
+        priority: int = 0,
     ) -> FleetHandle:
         """Route a request to the best replica; returns its streaming
         :class:`FleetHandle`.
@@ -493,9 +505,14 @@ class FleetRouter:
         any engine's request id) so every failover replay of the request
         samples identically on any replica.  ``deadline_s`` is a fleet-
         level wall-clock budget: each hop re-submits with the remaining
-        time.  Raises :class:`NoReplicaAvailable` (typed, retryable)
-        when no replica can take it, and plain ``ValueError`` for
-        requests that could never run anywhere (engine validation)."""
+        time.  ``tenant`` / ``priority`` are the request's QoS context
+        (see :mod:`torchdistx_tpu.serving.qos`), pinned on the handle
+        and forwarded with every re-submission — a stream preempted on
+        one replica and failed over to another keeps its class and its
+        tenant's fair-queueing share.  Raises
+        :class:`NoReplicaAvailable` (typed, retryable) when no replica
+        can take it, and plain ``ValueError`` for requests that could
+        never run anywhere (engine validation)."""
         if key is None:
             key = self._next_key
             self._next_key += 1
@@ -506,6 +523,8 @@ class FleetRouter:
             key,
             deadline_s,
             self.max_hops if max_hops is None else max_hops,
+            tenant=tenant,
+            priority=priority,
         )
         _T_SUBMITTED.add()
         try:

@@ -31,6 +31,10 @@ Sites (where the stack asks):
   crash-recovery supervisor (step = replay attempt).  ``io``/``nan``
   fail that replay, consuming the request's recovery budget — the path
   that proves budgets exhaust into typed errors instead of hangs.
+* ``serve.swap`` — before one swap-to-host page gather of the QoS
+  preemption path (step = swap attempt).  ``io``/``nan`` fail the swap
+  — the gather is read-only, so device state is untouched and the
+  preemption falls back to drop-and-replay, still token-identical.
 
 Kinds (what happens):
 
@@ -85,6 +89,7 @@ SITES = frozenset(
         "serve.prefill",
         "serve.step",
         "serve.recover",
+        "serve.swap",
     }
 )
 KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan"})

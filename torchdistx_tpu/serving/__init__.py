@@ -18,6 +18,12 @@ allocated per row up front.  This package replaces that for serving:
   prefill, slot recycling at chunk boundaries);
 * :mod:`.scheduler` — FIFO admission, the prefill/decode interleave
   knob, and the streaming :class:`~.scheduler.RequestHandle`;
+* :mod:`.qos`    — the SLO-aware multi-tenant scheduler
+  (``Engine(scheduler="qos")``): strict priority classes, per-tenant
+  weighted fair queueing over prefill-chunk cost, earliest-deadline-
+  first ordering, and the shed-by-priority overload policy; the engine
+  pairs it with preemption of running lower-class streams
+  (swap-to-host / drop-and-replay, both token-identical on resume);
 * :mod:`.lifecycle` — the request-lifecycle robustness layer: typed
   errors (deadline, cancel, shed, preempt, recovery), the
   :class:`~.lifecycle.Health` state machine
@@ -52,8 +58,16 @@ failover, and zero-downtime weight hot swap (docs/fleet.md).
 """
 
 from .blocks import BlockAllocator, blocks_needed  # noqa: F401
-from .cache import copy_pages, fresh_pool, init_paged_cache, write_prompt  # noqa: F401
+from .cache import (  # noqa: F401
+    copy_pages,
+    fresh_pool,
+    init_paged_cache,
+    swap_in_pages,
+    swap_out_pages,
+    write_prompt,
+)
 from .engine import Engine  # noqa: F401
+from .qos import QoSScheduler  # noqa: F401
 from .lifecycle import (  # noqa: F401
     DeadlineExceeded,
     EngineDraining,
@@ -78,6 +92,7 @@ __all__ = [
     "Health",
     "OverloadDetector",
     "PrefixIndex",
+    "QoSScheduler",
     "RecoveryFailed",
     "Request",
     "RequestCancelled",
@@ -89,5 +104,7 @@ __all__ = [
     "fresh_pool",
     "init_paged_cache",
     "page_hashes",
+    "swap_in_pages",
+    "swap_out_pages",
     "write_prompt",
 ]

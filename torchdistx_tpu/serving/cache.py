@@ -6,7 +6,7 @@ and dtype are probed from the family's own ``init_cache`` via
 ``jax.eval_shape`` — zero model coupling, so any family implementing the
 cache protocol (llama, gpt2, future ones) pages identically.
 
-Three device programs live here:
+Four device programs live here:
 
 * :func:`init_paged_cache` — allocate the zeroed pool.
 * :func:`write_prompt` — scatter a *contiguous* prefill cache (what the
@@ -24,6 +24,12 @@ Three device programs live here:
   stream about to write into a page whose refcount is > 1 (shared with
   the prefix index or another stream) gets its own copy first, so shared
   history is immutable.
+* :func:`swap_out_pages` / :func:`swap_in_pages` — the **swap-to-host**
+  preemption primitive (QoS): a preempted stream's pages gather to a
+  host buffer (read-only — a failed copy damages nothing) and later
+  scatter back into freshly-allocated pages.  Indices pad to
+  power-of-two buckets so compiles stay bounded; pad rows steer into
+  the trash page, safe by construction.
 """
 
 from __future__ import annotations
@@ -32,10 +38,18 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .blocks import TRASH_BLOCK
 
-__all__ = ["copy_pages", "fresh_pool", "init_paged_cache", "write_prompt"]
+__all__ = [
+    "copy_pages",
+    "fresh_pool",
+    "init_paged_cache",
+    "swap_in_pages",
+    "swap_out_pages",
+    "write_prompt",
+]
 
 
 def init_paged_cache(model, cfg, num_blocks: int, block_size: int):
@@ -97,6 +111,61 @@ def write_prompt(paged, contiguous, table, length, *, block_size: int):
         return pool.at[:, blk, off].set(cont[:, 0])
 
     return jax.tree.map(scatter, paged, contiguous)
+
+
+def _page_bucket(n: int) -> int:
+    """Swap-transfer pad width: next power of two — one gather and one
+    scatter compile per bucket, not per page count."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@jax.jit
+def _gather_pages(paged, idx):
+    return jax.tree.map(lambda pool: pool[:, idx], paged)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(paged, host, idx):
+    return jax.tree.map(
+        lambda pool, h: pool.at[:, idx].set(h), paged, host
+    )
+
+
+def swap_out_pages(paged, pages):
+    """Copy physical ``pages`` (every layer, both pools) to host.
+
+    Read-only: the pool is untouched, so a failure mid-copy leaves the
+    device state undamaged (the engine falls back to drop-and-replay).
+    Returns a host pytree ``{"k","v"}: (L, len(pages), bs, Hkv, Dh)``
+    of numpy arrays, rows in ``pages`` order."""
+    n = len(pages)
+    idx = np.full((_page_bucket(n),), TRASH_BLOCK, np.int32)
+    idx[:n] = pages
+    gathered = _gather_pages(paged, jnp.asarray(idx))
+    return jax.tree.map(lambda x: np.asarray(x[:, :n]), gathered)
+
+
+def swap_in_pages(paged, host, pages):
+    """Scatter a :func:`swap_out_pages` buffer back into freshly
+    allocated ``pages`` (the pool is donated — in place on device).
+    ``len(pages)`` must equal the buffer's page count; pad rows (zeros)
+    land in the trash page."""
+    n = len(pages)
+    bucket = _page_bucket(n)
+    idx = np.full((bucket,), TRASH_BLOCK, np.int32)
+    idx[:n] = pages
+
+    def pad(h):
+        out = np.zeros((h.shape[0], bucket) + h.shape[2:], h.dtype)
+        out[:, :n] = h
+        return out
+
+    return _scatter_pages(
+        paged, jax.tree.map(pad, host), jnp.asarray(idx)
+    )
 
 
 @partial(jax.jit, donate_argnums=(0,))

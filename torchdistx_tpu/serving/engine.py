@@ -69,6 +69,20 @@ allocator, and *replays* each live request by re-prefilling
 and sampled — under a per-request ``max_recoveries`` budget before a
 typed :class:`.lifecycle.RecoveryFailed`.
 
+**QoS** (``Engine(scheduler="qos")``): admission moves from FIFO to the
+SLO-aware :class:`.qos.QoSScheduler` — strict priority classes, per-
+tenant weighted fair queueing over prefill-chunk cost, earliest-
+deadline-first inside a (class, tenant) queue — and the engine gains
+**preemption** of running lower-class streams under page or slot
+pressure: **swap-to-host** (private pages gather to a host buffer and
+free — shared prefix pages stay mapped on their kept refs; the slot
+parks out of the decode batch exactly like a PREFILLING slot until
+pressure subsides) or **drop-and-replay** (pages free, the request
+requeues carrying its generated-so-far tokens and re-prefills them on
+re-admission).  Both resume token-identically — ``fold_in(key, n_gen)``
+again — and ``scheduler="fifo"`` (the default) leaves every existing
+behavior byte-identical.
+
 Fault sites (``TDX_FAULT``): ``serve.admit`` and ``serve.prefill`` —
 ``io``/``nan`` requeue at the FIFO head and the next tick retries;
 ``serve.step`` — ``io`` leaves state untouched (tick retries), ``nan``
@@ -76,7 +90,9 @@ marks the chunk poisoned and the engine skips it pre-dispatch (decode is
 a pure function of committed state, so the re-run is token-identical —
 the serving analog of the training loop's skip-step non-finite guard);
 ``serve.recover`` — fails one supervisor replay attempt, consuming
-recovery budget.  ``fatal`` propagates everywhere: fatal means fatal.
+recovery budget; ``serve.swap`` — fails one swap-to-host gather (read-
+only, device state untouched) and the preemption falls back to
+drop-and-replay.  ``fatal`` propagates everywhere: fatal means fatal.
 """
 
 from __future__ import annotations
@@ -95,7 +111,13 @@ from ..models.generate import _sample
 from ..resilience import faults
 from ..resilience import preemption as _preemption
 from .blocks import BlockAllocator, blocks_needed
-from .cache import copy_pages, fresh_pool, init_paged_cache
+from .cache import (
+    copy_pages,
+    fresh_pool,
+    init_paged_cache,
+    swap_in_pages,
+    swap_out_pages,
+)
 from .lifecycle import (
     DeadlineExceeded,
     EngineDraining,
@@ -107,6 +129,7 @@ from .lifecycle import (
     RequestPreempted,
 )
 from .prefix import PrefixIndex, page_hashes
+from .qos import QoSScheduler
 from .scheduler import FIFOScheduler, Request, RequestHandle
 
 __all__ = ["Engine"]
@@ -124,6 +147,8 @@ _T_CANCELLED = _telemetry.counter("serve.cancelled")
 _T_RECOVERIES = _telemetry.counter("serve.recoveries")
 _T_RECOVERY_FAILURES = _telemetry.counter("serve.recovery_failures")
 _T_PREEMPTED = _telemetry.counter("serve.preempted")
+_T_PREEMPT_SWAP = _telemetry.counter("serve.preemptions_swap")
+_T_PREEMPT_REPLAY = _telemetry.counter("serve.preemptions_replay")
 _T_PREFIX_HITS = _telemetry.counter("serve.prefix_hits")
 _T_PREFIX_HIT_TOKENS = _telemetry.counter("serve.prefix_hit_tokens")
 _T_COW = _telemetry.counter("serve.cow_copies")
@@ -259,12 +284,29 @@ class Engine:
         Off by default: sharing keeps finished requests' pages resident,
         which changes ``num_in_use`` accounting that embedding code may
         assert on; outputs are token-identical either way.
+    scheduler : ``"fifo"`` (default — byte-identical to the pre-QoS
+        engine) or ``"qos"`` (:class:`.qos.QoSScheduler`: strict
+        priority classes, per-tenant weighted fair queueing over
+        prefill-chunk cost, EDF within a class — plus preemption of
+        running lower-class streams, see ``preempt_mechanism``).
+    tenant_weights : ``{tenant: weight}`` fair-queueing shares
+        (``scheduler="qos"`` only); unlisted tenants weigh 1.
+    preempt_mechanism : how page pressure preempts a running
+        lower-class stream under QoS: ``"swap"`` (default — pages to a
+        host buffer, slot parks, swapped back in when pressure drops)
+        or ``"replay"`` (pages freed, request requeues with its
+        generated-so-far tokens and re-prefills them on re-admission).
+        Slot pressure always uses replay (only replay frees a slot);
+        a failed swap falls back to replay.  Both are invisible in the
+        token stream.
     max_queue / max_ttft_s : the overload detector's bounds (both None →
         never overloaded; see :class:`.lifecycle.OverloadDetector`).
     shed_policy : ``"reject-new"`` (overloaded ``submit`` raises
-        :class:`.lifecycle.EngineOverloaded`) or ``"drop-oldest"`` (the
+        :class:`.lifecycle.EngineOverloaded`), ``"drop-oldest"`` (the
         oldest *waiting* request is failed with it instead and the new
-        one is admitted).
+        one is admitted), or ``"by-priority"`` (QoS only: the victim is
+        the lowest class, youngest first — an arrival that is itself
+        the lowest class is the one rejected).
     max_recoveries : per-request replay budget of the crash-recovery
         supervisor before a typed :class:`.lifecycle.RecoveryFailed`.
     drain_deadline_s : wall-clock budget for in-flight work once a drain
@@ -299,6 +341,9 @@ class Engine:
         prefill_chunk: int = 512,
         prefix_cache: bool = False,
         min_prefill_bucket: int = 16,
+        scheduler: str = "fifo",
+        tenant_weights: Optional[dict] = None,
+        preempt_mechanism: str = "swap",
         max_queue: Optional[int] = None,
         max_ttft_s: Optional[float] = None,
         shed_policy: str = "reject-new",
@@ -334,10 +379,32 @@ class Engine:
             # _chunk_bucket doubles up from this value; <= 0 would never
             # terminate.
             raise ValueError("min_prefill_bucket must be >= 1")
-        if shed_policy not in ("reject-new", "drop-oldest"):
+        if scheduler not in ("fifo", "qos"):
             raise ValueError(
-                f"shed_policy {shed_policy!r}: expected 'reject-new' or "
-                "'drop-oldest'"
+                f"scheduler {scheduler!r}: expected 'fifo' or 'qos'"
+            )
+        self._qos = scheduler == "qos"
+        if tenant_weights is not None and not self._qos:
+            raise ValueError(
+                "tenant_weights needs scheduler='qos' (the FIFO scheduler "
+                "ignores tenancy — a silently-dropped weight map would "
+                "masquerade as fairness)"
+            )
+        if preempt_mechanism not in ("swap", "replay"):
+            raise ValueError(
+                f"preempt_mechanism {preempt_mechanism!r}: expected "
+                "'swap' or 'replay'"
+            )
+        self.preempt_mechanism = preempt_mechanism
+        if shed_policy not in ("reject-new", "drop-oldest", "by-priority"):
+            raise ValueError(
+                f"shed_policy {shed_policy!r}: expected 'reject-new', "
+                "'drop-oldest', or 'by-priority'"
+            )
+        if shed_policy == "by-priority" and not self._qos:
+            raise ValueError(
+                "shed_policy='by-priority' needs scheduler='qos' (the FIFO "
+                "scheduler has no priority classes to shed by)"
             )
         self.shed_policy = shed_policy
         self.max_recoveries = int(max_recoveries)
@@ -350,7 +417,11 @@ class Engine:
         if num_blocks is None:
             num_blocks = 1 + num_slots * self._table_width
         self.allocator = BlockAllocator(num_blocks, block_size)
-        self.scheduler = FIFOScheduler(max_prefills_per_tick)
+        self.scheduler = (
+            QoSScheduler(max_prefills_per_tick, tenant_weights)
+            if self._qos
+            else FIFOScheduler(max_prefills_per_tick)
+        )
         self.detector = OverloadDetector(max_queue, max_ttft_s)
         self.prefix: Optional[PrefixIndex] = (
             PrefixIndex(block_size) if prefix_cache else None
@@ -375,12 +446,24 @@ class Engine:
         # first token.  Strict FIFO: the head gets every chunk of the
         # tick's budget until it completes.
         self._prefill_q: list[int] = []
+        # Slots swapped to host (QoS preemption): they park in their
+        # slot, out of the decode batch exactly like PREFILLING slots
+        # (device table 0 → trash, done=True).  Only PRIVATE pages
+        # (refcount 1) transfer to host and free; shared pages (prefix
+        # index / CoW peers also hold them) stay mapped on the refs the
+        # request keeps — swapping them would duplicate them at
+        # swap-in.  slot -> (host KV pytree of the private rows,
+        # layout) where layout[i] is the kept page id or None for the
+        # i-th table position (None rows match host-buffer order).
+        self._swapped: dict[int, tuple] = {}
 
         self._next_rid = 0
         self._admit_no = 0  # admission attempts (serve.admit fault site)
         self._prefill_no = 0  # prefill dispatches (serve.prefill site)
         self._decode_no = 0  # decode chunks attempted (serve.step site)
         self._recover_no = 0  # supervisor replay attempts (serve.recover)
+        self._swap_no = 0  # swap-out attempts (serve.swap fault site)
+        self._preempted_this_tick = False  # swap-in back-off after a preempt
         self._decode_s = 0.0
         self._decode_tokens = 0
         self._consec_decode_failures = 0
@@ -389,6 +472,8 @@ class Engine:
         self._n_cancelled = 0
         self._n_recoveries = 0
         self._n_preempted = 0
+        self._n_preempt_swap = 0
+        self._n_preempt_replay = 0
         self._n_cow = 0
         # Bounded: stats() reports percentiles over the most recent
         # window, and a long-lived engine must not grow per-request state.
@@ -413,6 +498,8 @@ class Engine:
         max_new_tokens: int,
         key: Any = None,
         deadline_s: Optional[float] = None,
+        tenant: str = "default",
+        priority: int = 0,
     ) -> RequestHandle:
         """Queue a request; returns its streaming handle.
 
@@ -423,7 +510,15 @@ class Engine:
         ``deadline_s``: wall-clock budget from submission.  A request
         that has not finished when it expires fails with
         :class:`.lifecycle.DeadlineExceeded` at the next chunk boundary
-        and releases its pages there.
+        and releases its pages there.  Under ``scheduler="qos"`` the
+        deadline also *orders*: earliest-deadline-first within a
+        (priority, tenant) queue.
+
+        ``tenant`` / ``priority``: the request's QoS context —
+        fair-queueing share owner and priority class (higher admits
+        first and preempts running lower classes under pressure).
+        Inert under the default FIFO scheduler; carried either way so a
+        router can forward them unconditionally.
 
         Admissibility is validated HERE, immediately: a request that
         could never run — oversized for ``max_model_len``, needing more
@@ -452,6 +547,10 @@ class Engine:
             )
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be > 0 (or None)")
+        tenant = str(tenant)
+        if not tenant:
+            raise ValueError("tenant must be a non-empty string")
+        priority = int(priority)
         # Normalize the key BEFORE any shedding side effect: a malformed
         # key must raise without having killed a drop-oldest victim.
         if key is None:
@@ -496,15 +595,42 @@ class Engine:
                     f"{self.est_ttft_s():.3f}s);"
                     " retry with backoff"
                 )
-            victim = self.scheduler.shed_oldest()
-            if victim is not None:
-                _T_SHED.add()
-                self._n_shed += 1
-                victim.handle._fail(
-                    EngineOverloaded(
-                        f"request {victim.rid} shed under load (drop-oldest)"
+            if self.shed_policy == "by-priority":
+                # Victim = lowest class, youngest first — and only a
+                # STRICTLY lower class than the arrival's: an arrival
+                # that is itself the lowest WAITING class is the one
+                # shed.  An empty queue has no class to compare against
+                # — the overload is all in-flight work — so the arrival
+                # is admitted (same as drop-oldest with no victim) and
+                # the admit phase's preemption resolves the pressure.
+                victim = self.scheduler.shed_lowest(below_priority=priority)
+                if victim is not None:
+                    _T_SHED.add()
+                    self._n_shed += 1
+                    victim.handle._fail(
+                        EngineOverloaded(
+                            f"request {victim.rid} (priority="
+                            f"{victim.priority}) shed under load "
+                            "(by-priority)"
+                        )
                     )
-                )
+                elif len(self.scheduler):
+                    raise EngineOverloaded(
+                        "engine overloaded and the arriving request is "
+                        f"the lowest waiting class (priority={priority});"
+                        " retry with backoff"
+                    )
+            else:
+                victim = self.scheduler.shed_oldest()
+                if victim is not None:
+                    _T_SHED.add()
+                    self._n_shed += 1
+                    victim.handle._fail(
+                        EngineOverloaded(
+                            f"request {victim.rid} shed under load "
+                            "(drop-oldest)"
+                        )
+                    )
 
         rid = self._next_rid
         self._next_rid += 1
@@ -516,6 +642,7 @@ class Engine:
             Request(
                 rid, prompt, int(max_new_tokens), key, handle,
                 deadline=deadline, n_chunks=n_chunks, hashes=hashes,
+                tenant=tenant, priority=priority,
             )
         )
         _T_REQUESTS.add()
@@ -549,7 +676,7 @@ class Engine:
         for slot in self._prefill_q:
             req = self._slot_req[slot]
             if req is not None:
-                left = max(1, len(req.prompt) - req.prefill_pos)
+                left = max(1, req.replay_len() - req.prefill_pos)
                 pending += -(-left // self.prefill_chunk)
         return pending
 
@@ -573,10 +700,11 @@ class Engine:
         return sum(r is not None for r in self._slot_req)
 
     def _n_decoding(self) -> int:
-        """Slots in the decode batch (occupied and past their prefill)."""
+        """Slots in the decode batch (occupied, past their prefill,
+        and not swapped out to host)."""
         return sum(
             r is not None for i, r in enumerate(self._slot_req)
-            if i not in self._prefill_q
+            if i not in self._prefill_q and i not in self._swapped
         )
 
     # ------------------------------------------------------------------
@@ -593,9 +721,16 @@ class Engine:
         t0 = time.perf_counter()
         if self._health is not Health.DRAINING and _preemption.requested():
             self._begin_drain()
+        self._preempted_this_tick = False
         self._reap_phase()
         if self._health is not Health.DRAINING:
             self._admit_phase()
+        # Swapped slots resume even while DRAINING — they are in-flight
+        # work the drain contract promises to finish — but never on a
+        # tick that just preempted (the pressure that forced the swap
+        # out is by definition still there).
+        if self._swapped:
+            self._swap_in_phase()
         # Chunks advance even while DRAINING: a slot mid-prefill is
         # in-flight work the drain contract promises to finish.
         self._advance_prefills()
@@ -668,9 +803,14 @@ class Engine:
     def _fail_running_slot(self, slot: int, error) -> None:
         """Abort a running slot: pages back, handle failed typed, slot
         cleared.  The ONE place the release-on-failure choreography
-        lives (reap, drain deadline, and close all route here)."""
+        lives (reap, drain deadline, and close all route here).  A
+        swapped slot owns no pages — its host buffer is discarded and
+        the allocator's swap account settled instead."""
         req = self._slot_req[slot]
-        self.allocator.free(req.blocks)
+        if slot in self._swapped:
+            self._discard_swapped(slot)
+        elif req.blocks:
+            self.allocator.free(req.blocks)
         req.blocks = None
         req.handle._fail(error)
         self._clear_slot(slot)
@@ -695,7 +835,8 @@ class Engine:
             req.handle._fail(
                 RequestPreempted(
                     f"request {req.rid} flushed before prefill: engine "
-                    "draining; retry against another replica"
+                    "draining; retry against another replica",
+                    resumable=True,  # zero tokens yielded: resubmit = resume
                 )
             )
 
@@ -715,7 +856,8 @@ class Engine:
                         f"request {req.rid} preempted mid-stream: drain "
                         f"deadline ({self.drain_deadline_s}s) expired after "
                         f"{self._emitted[slot]} tokens; retry against "
-                        "another replica"
+                        "another replica",
+                        resumable=self._emitted[slot] == 0,
                     ),
                 )
             self._finish_drain(timed_out=True)
@@ -768,7 +910,8 @@ class Engine:
                 RequestPreempted(
                     f"request {req.rid} aborted after "
                     f"{self._emitted[slot]} tokens: engine closed; retry "
-                    "against another replica"
+                    "against another replica",
+                    resumable=self._emitted[slot] == 0,
                 ),
             )
         self._finish_drain(timed_out=False)
@@ -780,10 +923,25 @@ class Engine:
         if not len(self.scheduler):
             return
         if self._prefill_q:
-            # Prefill-busy: popping more requests would only park them on
-            # pages with zero progress (chunks drain strictly FIFO).
-            # Admission resumes the tick the queue of chunks empties.
-            return
+            if self._qos:
+                # A strictly-higher-class head must not wait out a
+                # lower class's chunked prefill (priority inversion
+                # through the prefill queue): abort-and-requeue those
+                # prefills — they have no committed tokens, so the
+                # requeue is the cheap end of drop-and-replay.
+                self._preempt_prefills()
+            if self._prefill_q:
+                # Prefill-busy: popping more requests would only park
+                # them on pages with zero progress (chunks drain
+                # strictly FIFO).  Admission resumes the tick the queue
+                # of chunks empties.
+                return
+        if self._qos:
+            # Before admission reads the free lists: a waiting request
+            # of a strictly higher class may preempt running lower ones
+            # to make room — same tick, so a high-priority arrival never
+            # waits out a low-priority stream's whole budget.
+            self._qos_preempt()
         free_slots = [
             i for i, r in enumerate(self._slot_req) if r is None
         ]
@@ -828,6 +986,232 @@ class Engine:
                 return
 
     # ------------------------------------------------------------------
+    # QoS preemption: swap-to-host / drop-and-replay (scheduler="qos")
+
+    def _preempt_prefills(self) -> None:
+        """Abort mid-prefill slots when the waiting head outranks every
+        one of them: their pages return, they re-enter the QoS queues
+        (losing only the chunks already dispatched), and this tick's
+        prefill budget goes to the higher class instead.  Nothing
+        happens while any prefilling slot is the head's class or above
+        — chunk progress is never sacrificed to an equal."""
+        head = self.scheduler.peek()
+        if head is None:
+            return
+        if not all(
+            self._slot_req[slot].priority < head.priority
+            for slot in self._prefill_q
+        ):
+            return
+        for slot in list(self._prefill_q):
+            req = self._abort_prefill(slot)
+            req.n_chunks = self._replay_chunks(req)
+            self.scheduler.push(req)
+            self._n_preempt_replay += 1
+            _T_PREEMPT_REPLAY.add()
+        self._preempted_this_tick = True
+
+    def _replay_chunks(self, req: Request) -> int:
+        """A preemption victim's resume cost in chunks — what the
+        re-prefill of prompt + generated-so-far will really dispatch,
+        minus whatever prefix the index still holds (re-admission maps
+        it again), mirroring submit's cache-aware estimate.  The WFQ
+        fare and the TTFT estimate both read it."""
+        seq_len = req.replay_len()
+        cached = 0
+        if self.prefix is not None and req.hashes:
+            cached = self.prefix.probe(req.hashes) * self.block_size
+        return -(-max(1, seq_len - cached) // self.prefill_chunk)
+
+    def _qos_preempt(self) -> None:
+        """Make room for a waiting higher-class request by preempting
+        running strictly-lower-class streams.  Victim order: lowest
+        class first, youngest first (least work lost).  Two pressures,
+        two mechanisms:
+
+        * **slot pressure** (every slot occupied) → **drop-and-replay**
+          on one victim: its pages release and it requeues with its
+          generated-so-far tokens; re-admission re-prefills
+          ``prompt + tokens`` via the supervisor's replay sequence —
+          ``fold_in(key, n_gen)`` keeps the resumed stream
+          token-identical;
+        * **page pressure** (the head's reservation exceeds the free
+          list) → ``preempt_mechanism`` per victim: ``"swap"`` copies
+          the victim's private pages to a host buffer and frees them,
+          keeping shared ones mapped (the slot stays parked, out of
+          the decode batch like a PREFILLING slot, until
+          :meth:`_swap_in_phase` brings it back); ``"replay"``
+          drops and requeues as above.  A ``serve.swap`` ``io`` fault
+          falls back to drop-and-replay — the gather is read-only, so
+          the failed swap leaves device state untouched.
+        """
+        head = self.scheduler.peek()
+        if head is None:
+            return
+        victims = sorted(
+            (
+                slot
+                for slot, req in enumerate(self._slot_req)
+                if req is not None and req.priority < head.priority
+            ),
+            key=lambda s: (
+                self._slot_req[s].priority, -self._slot_req[s].rid,
+            ),
+        )
+        if not victims:
+            return
+        if all(r is not None for r in self._slot_req):
+            # Slot pressure: only replay frees a slot (a swapped slot
+            # stays parked in its slot).  Swapped victims qualify too —
+            # their host buffer is discarded and they requeue.
+            self._preempt_slot(victims.pop(0), mechanism="replay")
+        need = blocks_needed(head.cache_tokens, self.block_size)
+        if need > self.allocator.num_free:
+            self._reclaim_pages(need - self.allocator.num_free)
+        while need > self.allocator.num_free and victims:
+            slot = victims.pop(0)
+            if slot in self._swapped:
+                # Its private pages are already on host and its kept
+                # shared pages stay resident on the index's/peers'
+                # references either way: nothing to free here.
+                continue
+            self._preempt_slot(slot, mechanism=self.preempt_mechanism)
+
+    def _preempt_slot(self, slot: int, mechanism: str) -> None:
+        """Preempt one running slot.  ``"swap"``: pages gather to host,
+        slot parks (decode batch exit = the PREFILLING rule: device
+        table 0 → trash, done=True).  ``"replay"``: pages release and
+        the request re-enters the QoS queues carrying its committed
+        tokens; a swapped victim's host buffer is discarded the same
+        way."""
+        req = self._slot_req[slot]
+        self._preempted_this_tick = True
+        if mechanism == "swap" and slot not in self._swapped:
+            # Only PRIVATE pages (refcount 1) go to host: a shared page
+            # (prefix index or a CoW peer holds it too) stays resident
+            # whether we drop our ref or not, so transferring it would
+            # free nothing now and duplicate it at swap-in.  The
+            # request KEEPS its references on shared pages — sharing is
+            # preserved across the preemption and others' writes still
+            # see refcount > 1 and copy-on-write first.
+            layout = [
+                blk if self.allocator.refcount(blk) > 1 else None
+                for blk in req.blocks
+            ]
+            priv = [
+                blk for blk, kept in zip(req.blocks, layout)
+                if kept is None
+            ]
+            self._swap_no += 1
+            try:
+                kind = faults.fire("serve.swap", self._swap_no)
+                if kind is not None:
+                    # Cooperation kinds (nan) poison this swap attempt:
+                    # same contract as io — fall back to replay.
+                    raise faults.InjectedFault(
+                        f"poisoned swap attempt ({kind})"
+                    )
+                host = swap_out_pages(self._cache, priv) if priv else None
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except faults.FatalInjectedFault:
+                raise
+            except Exception:
+                # The gather is read-only: device state is untouched,
+                # so drop-and-replay below is safe and token-identical.
+                pass
+            else:
+                self.allocator.swap_out(priv)
+                self._swapped[slot] = (host, layout)
+                req.blocks = None
+                self._tables[slot] = 0
+                self._done[slot] = True
+                self._n_preempt_swap += 1
+                _T_PREEMPT_SWAP.add()
+                return
+        # Drop-and-replay (the swap fallback lands here too).
+        if slot in self._swapped:
+            self._discard_swapped(slot)
+        elif req.blocks:
+            self.allocator.free(req.blocks)
+        self._reset_prefill_state(req)
+        req.n_chunks = self._replay_chunks(req)
+        self._clear_slot(slot)
+        self.scheduler.push(req)
+        self._n_preempt_replay += 1
+        _T_PREEMPT_REPLAY.add()
+
+    def _discard_swapped(self, slot: int) -> None:
+        """Settle a swapped slot's accounts without resuming it (the
+        request was cancelled, failed, or re-preempted to replay): the
+        kept shared pages' references release, the host buffer is
+        dropped, and the allocator forgets the host-resident rows."""
+        _, layout = self._swapped.pop(slot)
+        kept = [blk for blk in layout if blk is not None]
+        if kept:
+            self.allocator.free(kept)
+        self.allocator.drop_swapped(
+            sum(1 for blk in layout if blk is None)
+        )
+
+    def _swap_in_phase(self) -> None:
+        """Bring swapped slots back when pressure subsides: highest
+        class first, oldest first.  A swapped slot never jumps a
+        waiting *higher*-class head — its pages stay reserved for it —
+        and never resumes on the tick that just preempted."""
+        if self._preempted_this_tick:
+            return
+        head = self.scheduler.peek() if self._qos else None
+        for slot in sorted(
+            self._swapped,
+            key=lambda s: (
+                -self._slot_req[s].priority, self._slot_req[s].rid,
+            ),
+        ):
+            req = self._slot_req[slot]
+            host, layout = self._swapped[slot]
+            n_priv = sum(1 for kept in layout if kept is None)
+            reserve = 0
+            if head is not None and head.priority > req.priority:
+                reserve = blocks_needed(head.cache_tokens, self.block_size)
+            short = n_priv + reserve - self.allocator.num_free
+            if short > 0:
+                self._reclaim_pages(short)
+            if self.allocator.num_free - reserve < n_priv:
+                continue
+            pages = self.allocator.swap_in(n_priv)
+            if pages is None:
+                continue
+            if n_priv:
+                try:
+                    self._cache = swap_in_pages(self._cache, host, pages)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except faults.FatalInjectedFault:
+                    raise
+                except Exception as err:
+                    # The scatter held the pool donated: a failure here
+                    # is a device failure — the supervisor rebuilds and
+                    # replays everything (swapped slots included, as
+                    # replays).  The just-granted pages die with the
+                    # map.
+                    self._swapped.pop(slot, None)
+                    self._supervise_recovery(err)
+                    return
+            del self._swapped[slot]
+            fresh = iter(pages)
+            blocks = [
+                kept if kept is not None else next(fresh)
+                for kept in layout
+            ]
+            req.blocks = blocks
+            table = np.zeros((self._table_width,), np.int32)
+            table[: len(blocks)] = blocks
+            req.table = table
+            self._tables[slot] = table
+            self._done[slot] = False
+
+    # ------------------------------------------------------------------
     # Chunked prefill + the prefix cache
 
     def _reclaim_pages(self, n: int) -> int:
@@ -857,7 +1241,16 @@ class Engine:
         map the longest cached prefix (shared, refcounted), reserve
         private pages for the rest of the table, and queue the slot for
         chunk dispatch.  No device work happens here; on any failure the
-        reservation rolls back completely."""
+        reservation rolls back completely.
+
+        A drop-and-replay preemption victim re-admits through this same
+        path: its prefill runs over :meth:`~.scheduler.Request
+        .replay_seq` (``prompt + generated-so-far``) instead of the
+        prompt — the supervisor's replay sequence, chunked and
+        interleaved with decode like any admission — and
+        :meth:`_complete_prefill` restores the slot mid-stream instead
+        of sampling a first token."""
+        seq_len = req.replay_len()
         n_total = blocks_needed(req.cache_tokens, self.block_size)
         shared: list = []
         cached_len = 0
@@ -890,10 +1283,11 @@ class Engine:
         table[: len(req.blocks)] = req.blocks
         req.table = table
         req.n_cached = cached_len
-        # Full-prompt hit: the first sample still needs the last token's
-        # logits, so recompute exactly that token — its write lands in
-        # the final shared page, which copy-on-write privatizes first.
-        req.prefill_pos = min(cached_len, len(req.prompt) - 1)
+        # Full-sequence hit: the first sample (or a resume's discarded
+        # recomputation) still needs the last token's logits, so
+        # recompute exactly that token — its write lands in the final
+        # shared page, which copy-on-write privatizes first.
+        req.prefill_pos = min(cached_len, seq_len - 1)
         self._slot_req[slot] = req
         # Slot arrays stay idle (done=True, device table 0 → trash)
         # until the last chunk installs them — the decode batch must not
@@ -909,8 +1303,9 @@ class Engine:
         while budget > 0 and self._prefill_q:
             slot = self._prefill_q[0]
             req = self._slot_req[slot]
+            seq = req.replay_seq()  # = prompt, unless resuming a preempt
             start = req.prefill_pos
-            end = min(start + self.prefill_chunk, len(req.prompt))
+            end = min(start + self.prefill_chunk, len(seq))
             self._prefill_no += 1
             try:
                 kind = faults.fire("serve.prefill", self._prefill_no)
@@ -923,7 +1318,7 @@ class Engine:
                 _T_PREFILL_RETRIES.add()
                 return
             try:
-                first = self._dispatch_chunk(slot, req, start, end)
+                first = self._dispatch_chunk(slot, req, seq, start, end)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except faults.FatalInjectedFault:
@@ -998,8 +1393,11 @@ class Engine:
         )
         return None
 
-    def _dispatch_chunk(self, slot: int, req: Request, start: int, end: int):
-        """One admission-path chunk: CoW anything the chunk (padding
+    def _dispatch_chunk(
+        self, slot: int, req: Request, seq, start: int, end: int
+    ):
+        """One admission-path chunk of ``seq`` (the prompt, or a
+        resume's replay sequence): CoW anything the chunk (padding
         included) would write, then run it."""
         bucket = self._chunk_bucket(end - start)
         self._cow_shared_pages(req, start, start + bucket)
@@ -1007,7 +1405,7 @@ class Engine:
             "serve.prefill", slot=slot, start=start, n=end - start,
             bucket=bucket, cached=req.n_cached,
         ):
-            return self._run_chunk(req.prompt, req.table, start, end, req.key)
+            return self._run_chunk(seq, req.table, start, end, req.key)
 
     def _complete_prefill(self, slot: int, req: Request, first: int) -> None:
         """Last chunk done: register the prompt's full pages in the
@@ -1018,6 +1416,21 @@ class Engine:
                 [int(req.table[i]) for i in range(len(req.hashes))],
                 self.allocator,
             )
+        toks = req.handle._tokens
+        if toks:
+            # A drop-and-replay preemption victim resuming: the sampled
+            # token is a recomputation of an already-committed one —
+            # discard it; the pending input is the last committed token
+            # and the key schedule continues at fold_in(key, n_gen).
+            # TTFT was recorded at the original first token.
+            self._tokens[slot] = toks[-1]
+            self._positions[slot] = req.replay_len()
+            self._n_gen[slot] = len(toks)
+            self._done[slot] = False
+            self._keys[slot] = req.key
+            self._tables[slot] = req.table
+            self._emitted[slot] = len(toks)
+            return
         req.handle.ttft_s = time.perf_counter() - req.submit_t
         self._ttft.append(req.handle.ttft_s)
         _G_TTFT.set(round(req.handle.ttft_s, 4))
@@ -1176,9 +1589,10 @@ class Engine:
 
         committed = 0
         for slot, req in enumerate(self._slot_req):
-            if req is None or slot in self._prefill_q:
-                # Mid-prefill slots rode the batch as done-slots writing
-                # trash; they have no tokens to commit.
+            if req is None or slot in self._prefill_q or slot in self._swapped:
+                # Mid-prefill and swapped-out slots rode the batch as
+                # done-slots writing trash; they have no tokens to
+                # commit.
                 continue
             for tok in out[:, slot]:
                 self._push_token(slot, int(tok))
@@ -1254,6 +1668,12 @@ class Engine:
                 requeue.append(req)
             self._clear_slot(slot)
         self.scheduler.requeue(requeue)
+        # Swapped slots: their host buffers are still valid, but the
+        # committed tokens on the handle are all a replay needs —
+        # discard the buffers and replay those streams like any
+        # decoding slot.  The allocator reset below re-zeroes the swap
+        # account along with the ownership map.
+        self._swapped.clear()
         if self.prefix is not None:
             self.prefix.clear()
         pending = [
@@ -1398,6 +1818,9 @@ class Engine:
             "cancelled": self._n_cancelled,
             "recoveries": self._n_recoveries,
             "preempted": self._n_preempted,
+            "preemptions_swap": self._n_preempt_swap,
+            "preemptions_replay": self._n_preempt_replay,
+            "swapped_pages": self.allocator.num_swapped,
         }
         if self.prefix is not None:
             out["prefix_cached_pages"] = len(self.prefix)

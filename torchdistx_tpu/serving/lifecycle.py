@@ -99,11 +99,27 @@ class EngineDraining(RequestError):
 
 
 class RequestPreempted(RequestError):
-    """Failed by a drain: either flushed from the queue when drain
-    began, or still in flight when the drain deadline expired.  The
-    stream is *explicitly* truncated — retry against another replica."""
+    """Failed by a drain or an engine close: either flushed from the
+    queue before starting, or cut off in flight.  The stream is
+    *explicitly* truncated — retry against another replica.
+
+    ``resumable`` tells the client what a retry costs: ``True`` means
+    the request had yielded **no tokens yet** (flushed from the queue,
+    or preempted before its first token) — a plain re-submission
+    resumes it losslessly.  ``False`` means it was cut mid-stream: a
+    lossless resume needs a key-pinned, token-verified replay (what
+    :class:`~torchdistx_tpu.fleet.FleetHandle` does automatically);
+    naive re-submission would restart the stream from token 0.
+
+    QoS preemptions (swap-to-host / drop-and-replay) never raise this —
+    the engine resumes those itself, invisibly in the token stream."""
 
     retryable = True
+    resumable: bool = False
+
+    def __init__(self, *args, resumable: bool = False):
+        super().__init__(*args)
+        self.resumable = resumable
 
 
 class RecoveryFailed(RequestError):

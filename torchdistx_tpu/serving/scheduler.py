@@ -68,6 +68,9 @@ class Request:
     n_cached: int = 0  # prompt tokens served from the prefix cache
     hashes: Optional[list] = None  # chained full-page hashes of the prompt
     hit_counted: bool = False  # prefix hit recorded (once per request)
+    # QoS context (inert under the FIFO scheduler; see qos.py):
+    tenant: str = "default"  # fair-queueing share owner
+    priority: int = 0  # priority class — higher admits (and preempts) first
 
     @property
     def cache_tokens(self) -> int:
@@ -76,6 +79,26 @@ class Request:
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
+
+    def replay_len(self) -> int:
+        """Length of :meth:`replay_seq` without building it."""
+        return len(self.prompt) + max(0, len(self.handle._tokens) - 1)
+
+    def replay_seq(self) -> np.ndarray:
+        """The sequence a (re-)prefill of this request must run.
+
+        A fresh request prefills its prompt.  A request with committed
+        tokens (a drop-and-replay preemption victim, or a supervisor
+        replay) re-prefills ``prompt + tokens[:-1]``: every committed
+        token but the last was already *fed* to the model, and the last
+        is the slot's pending input token.  ``fold_in(key, n_gen)``
+        sampling makes the continuation token-identical either way."""
+        toks = self.handle._tokens
+        if not toks:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(toks[:-1], np.int32)]
+        ).astype(np.int32)
 
 
 class RequestHandle:
