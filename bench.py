@@ -519,10 +519,22 @@ def bench_serving():
     there the whole batch finishes together and the cache is allocated
     at ``prompt+max_new`` per row; here slots recycle the moment a
     request's budget lands and pages free with them.
+
+    Latency numbers come from the telemetry layer, not ad-hoc lists:
+    TTFT/TPOT percentiles read back from the per-engine ``serve.*``
+    histograms (via ``Engine.stats()``), and the per-tenant QoS numbers
+    from :func:`scripts.trace_report.reconstruct` over the run's own
+    event stream — the same reconstruction path a production trace or
+    chaos soak goes through, so bench and post-mortem numbers can never
+    drift apart.
     """
+    import os
+    import sys
+
     import jax
     import numpy as np
 
+    from torchdistx_tpu import telemetry
     from torchdistx_tpu.models import llama
     from torchdistx_tpu.parallel.mesh import make_mesh, MeshSpec
     from torchdistx_tpu.serving import (
@@ -531,6 +543,17 @@ def bench_serving():
         swap_in_pages,
         swap_out_pages,
     )
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+    )
+    from trace_report import reconstruct
+
+    # Collect the run's own trace in memory: the reconstruction below
+    # reads the SAME event stream a production TDX_TELEMETRY trace
+    # carries (restored to the caller's settings at the end).
+    prev_telemetry = telemetry.configure(collect=True, max_spans=65536)
+    telemetry.drain()
 
     cfg = llama.LlamaConfig(
         vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
@@ -590,9 +613,13 @@ def bench_serving():
             peak_util = max(peak_util, eng.allocator.utilization())
         return time.perf_counter() - t0, peak_util, eng.stats()
 
+    telemetry.drain()  # warm-up records are not the measured trace
     eng = make_engine()
     wall, peak_util, st = run_trace(eng, prompts, outs, arrival)
     total_tokens = int(sum(outs))
+    # Reconstruct the measured run's own event stream — bench numbers
+    # ride the same per-request timeline path as a production trace.
+    trace_summary = reconstruct(telemetry.drain()).summary()
 
     # Prefix-heavy phase (the production shape: ~80% of traffic behind
     # one system prompt): the SAME trace runs against a cache-off and a
@@ -697,6 +724,7 @@ def bench_serving():
     deadline_s = max(1.0, 8.0 * unit_s)
 
     def run_multi_tenant(eng):
+        telemetry.drain()
         burst_handles = [
             eng.submit(
                 p, max_new_tokens=int(o), key=100 + i, tenant="burst",
@@ -718,16 +746,35 @@ def bench_serving():
                 i += 1
             eng.step()
             tick += 1
+        # Per-tenant numbers from the run's reconstructed timelines (the
+        # tenant rides each req.submitted event) — not ad-hoc handle
+        # lists: the trace is the single source of latency truth.
+        rep = reconstruct(telemetry.drain())
+        ttfts = {"burst": [], "steady": []}
+        n_seen = {"burst": 0, "steady": 0}
+        n_done = {"burst": 0, "steady": 0}
+        for tl in rep.requests.values():
+            sub = next(
+                e for e in tl._sorted() if e["name"] == "req.submitted"
+            )
+            tenant = (sub.get("attrs") or {}).get("tenant", "default")
+            n_seen[tenant] += 1
+            if tl.outcome == "finished":
+                n_done[tenant] += 1
+            if tl.ttft_s is not None:
+                ttfts[tenant].append(tl.ttft_s)
         out = {}
-        for tenant, hs in (("burst", burst_handles), ("steady", steady_handles)):
-            ttfts = [h.ttft_s for h in hs if h.ttft_s is not None]
-            row = {"n": len(hs), "completed": sum(h.error is None for h in hs)}
-            if ttfts:
-                row["ttft_p95_s"] = round(float(np.percentile(ttfts, 95)), 4)
+        for tenant in ("burst", "steady"):
+            row = {"n": n_seen[tenant], "completed": n_done[tenant]}
+            if ttfts[tenant]:
+                row["ttft_p95_s"] = round(
+                    float(np.percentile(ttfts[tenant], 95)), 4
+                )
             out[tenant] = row
         out["steady"]["deadline_hit_rate"] = round(
-            sum(h.error is None for h in steady_handles) / n_steady, 3
+            n_done["steady"] / n_steady, 3
         )
+        out["trace_complete"] = not rep.problems()
         st = eng.stats()
         out["preemptions_swap"] = st.get("preemptions_swap", 0)
         out["preemptions_replay"] = st.get("preemptions_replay", 0)
@@ -757,6 +804,7 @@ def bench_serving():
         3,
     )
 
+    telemetry.configure(**prev_telemetry)
     return {
         "n_requests": n_req,
         "num_slots": num_slots,
@@ -766,10 +814,23 @@ def bench_serving():
         "total_new_tokens": total_tokens,
         "wall_s": round(wall, 3),
         "e2e_tokens_per_s": round(total_tokens / wall, 1),
+        # TTFT/TPOT percentiles read back from the per-engine telemetry
+        # histograms (stats() is a view over them since ISSUE 9).
         "sustained_decode_tokens_per_s": st.get("decode_tokens_per_s"),
         "ttft_p50_s": st.get("ttft_p50_s"),
         "ttft_p95_s": st.get("ttft_p95_s"),
+        "tpot_p50_s": st.get("tpot_p50_s"),
+        "tpot_p95_s": st.get("tpot_p95_s"),
         "peak_block_utilization": round(peak_util, 4),
+        # The run's own reconstructed timelines (scripts/trace_report.py):
+        # every request must reconstruct complete, and the phase totals
+        # say where the wall time went.
+        "trace": {
+            "n_requests": trace_summary["n_requests"],
+            "complete": trace_summary["complete"],
+            "phase_totals_s": trace_summary["phase_totals_s"],
+            "problems": len(trace_summary["problems"]),
+        },
         "prefix_heavy": prefix,
         "multi_tenant": multi,
     }
@@ -881,6 +942,12 @@ def bench_fleet_failover():
             - float(np.percentile(lat_clean, 95)),
             4,
         )
+    # The direct measurement (the fleet.failover_added_s histogram times
+    # failure→re-placement per hop, backoff included) alongside the
+    # derived pull-latency delta above.
+    h = telemetry.histogram("fleet.failover_added_s")
+    if h.count:
+        out["failover_added_p95_s_hist"] = round(h.percentile(95), 4)
     return out
 
 

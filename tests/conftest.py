@@ -38,5 +38,6 @@ else:
         "test_models.py",
         "test_sharding_plans.py",
         "test_slowmo.py",
+        "test_trace_report.py",
         "test_train_step.py",
     ]

@@ -93,6 +93,7 @@ def test_hybrid_mesh_collective_crosses_axes():
     assert float(total) == 28.0
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): heavy; slow lane
 def test_multiprocess_train_and_slowmo_match_single_process():
     """The real multi-process harness (reference bar: FSDPTest's
     multi-process spawn, tests/python/test_slowmo_fsdp.py): 2 JAX processes

@@ -504,6 +504,7 @@ def test_failover_forwards_qos_context(family):
 # Mini fleet chaos (the CI-scale soak lives in scripts/chaos_soak.py)
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): the CI fleet-chaos job covers this scenario
 def test_fleet_mini_chaos_kill_and_swap(family):
     """Mixed traffic over 2 engines; one is killed mid-load (device
     failure + close) and a hot-swap retires the other: every request

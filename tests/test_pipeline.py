@@ -249,6 +249,7 @@ def test_1f1b_train_step_matches_gpipe():
     bool(__import__("os").environ.get("CI")),
     reason="wall-clock comparison: meaningless on loaded shared CI runners",
 )
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): heavy; slow lane
 def test_1f1b_wallclock_not_worse_than_gpipe():
     """At M = 2P with rematerialized blocks, 1F1B's tick count (2M + 2P - 3)
     carries the same total compute as GPipe's forward+transpose — assert

@@ -46,6 +46,7 @@ def test_resnet_constructs_fake(fake_resnet):
 
 
 @needs_jax
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): heavy; slow lane
 def test_resnet_jax_materialize_no_fallback(fake_resnet):
     # _fallback_torch=False: raises if ANY param would take the torch
     # replay+transfer fallback — the zero-fallback assertion of VERDICT #5.

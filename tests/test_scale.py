@@ -127,6 +127,7 @@ def test_scaled_down_materialization_is_exact():
     assert len(arrays) == n_expected
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): heavy; slow lane
 def test_1b_tape_path_sharded_materialize_rss_wall_and_equality():
     """Tape-path twin of the native proof below (VERDICT r4 item 1, the
     north-star configuration: BASELINE configs 4-5 are deferred-init *HF*
@@ -201,6 +202,7 @@ def test_1b_tape_path_sharded_materialize_rss_wall_and_equality():
         del got, want
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): heavy; slow lane
 def test_1b_sharded_init_rss_and_shard_equality():
     """Scaled pod-shape proof (BASELINE configs 4-5, north star): a
     ~1.35B-param Llama initializes SHARDED over the 8-device mesh —

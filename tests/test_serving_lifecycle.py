@@ -600,6 +600,7 @@ def test_close_fails_outstanding_and_restores(family):
 # Seeded mini chaos soak (the CI-scale soak lives in scripts/chaos_soak.py)
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): the CI chaos-soak job covers this scenario
 def test_chaos_mini_soak(monkeypatch, family):
     """Randomized faults + lifecycle churn over mixed requests: every
     request completes token-identical to solo generate() or fails with a

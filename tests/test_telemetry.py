@@ -285,3 +285,316 @@ def test_counters_survive_concurrent_recording():
         t.join()
     assert not errors
     assert ops.value - before == n_threads * per_thread * per_module
+
+
+# ---------------------------------------------------------------------------
+# Histograms (ISSUE 9)
+
+
+def test_histogram_exact_counts_and_percentiles():
+    h = telemetry.Histogram("test.h", bounds=[1.0, 2.0, 4.0, 8.0])
+    for v in (0.5, 0.5, 1.5, 3.0, 3.5, 10.0):
+        h.observe(v)
+    assert h.count == 6
+    assert h.sum == pytest.approx(19.0)
+    s = h.summary()
+    assert s["count"] == 6
+    assert s["min"] == 0.5 and s["max"] == 10.0
+    # Percentiles interpolate within a bucket and clamp to observed
+    # min/max — never outside the data.
+    assert s["min"] <= s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+    # p99 of 6 observations lands in the overflow bucket: past the last
+    # edge (8.0), clamped by the observed max.
+    assert 8.0 <= s["p99"] <= 10.0
+
+
+def test_histogram_aggregated_observe_and_bounds_validation():
+    h = telemetry.Histogram("test.h2", bounds=[0.1, 1.0])
+    h.observe(0.05, n=100)  # one aggregated observation per decode chunk
+    assert h.count == 100
+    assert h.sum == pytest.approx(5.0)
+    with pytest.raises(ValueError):
+        telemetry.Histogram("bad", bounds=[1.0, 1.0])
+
+
+def test_histogram_thread_exact():
+    h = telemetry.histogram("test.h_threads", bounds=[0.5, 1.5])
+    n_threads, n_obs = 8, 5000
+
+    def work():
+        for _ in range(n_obs):
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n_threads * n_obs
+    assert telemetry.histograms()["test.h_threads"]["count"] == h.count
+
+
+def test_labeled_metrics_scope_per_engine():
+    # The SAME (name, labels) resolves to the same instrument; different
+    # labels to different ones — N replicas stop clobbering one gauge.
+    g0 = telemetry.gauge("test.health", engine="eng0")
+    g1 = telemetry.gauge("test.health", engine="eng1")
+    assert g0 is not g1
+    assert g0 is telemetry.gauge("test.health", engine="eng0")
+    g0.set("ready")
+    g1.set("stopped")
+    vals = telemetry.gauges()
+    assert vals["test.health{engine=eng0}"] == "ready"
+    assert vals["test.health{engine=eng1}"] == "stopped"
+    c = telemetry.counter("test.shed", engine="eng0")
+    c.add(2)
+    assert telemetry.counters()["test.shed{engine=eng0}"] == 2
+    h = telemetry.histogram("test.lat", engine="eng0")
+    assert h is telemetry.histogram("test.lat", engine="eng0")
+
+
+# ---------------------------------------------------------------------------
+# Request events + trace context (ISSUE 9)
+
+
+def test_event_carries_trace_context_and_nests():
+    telemetry.configure(collect=True)
+    with telemetry.tracing(rid="r1", engine="eng0", hop=0):
+        telemetry.event("req.submitted", n_prompt=4)
+        with telemetry.tracing(hop=1):  # inner scope inherits + overrides
+            telemetry.event("req.failover_hop")
+        with telemetry.span("serve.prefill", n=4):
+            pass
+    telemetry.event("req.other", rid="r2")  # explicit kwargs, no scope
+    recs = telemetry.drain()
+    by_name = {r["name"]: r for r in recs}
+    sub = by_name["req.submitted"]
+    assert (sub["rid"], sub["engine"], sub["hop"]) == ("r1", "eng0", 0)
+    assert sub["attrs"] == {"n_prompt": 4}
+    hop = by_name["req.failover_hop"]
+    assert (hop["rid"], hop["engine"], hop["hop"]) == ("r1", "eng0", 1)
+    # Spans started inside the scope carry the context too.
+    span = by_name["serve.prefill"]
+    assert span["type"] == "span" and span["rid"] == "r1"
+    assert by_name["req.other"]["rid"] == "r2"
+    assert "engine" not in by_name["req.other"]
+
+
+def test_events_enabled_gates_on_sinks_and_flight():
+    assert not telemetry.events_enabled()
+    telemetry.configure(collect=True)
+    assert telemetry.events_enabled()
+    telemetry.configure(collect=False)
+    assert not telemetry.events_enabled()
+    # The flight ring alone counts: events must reach the ring even
+    # with every sink off — that is the recorder's whole point.
+    telemetry.configure(flight=True)
+    assert telemetry.events_enabled()
+    telemetry.configure(flight=None)
+    assert not telemetry.events_enabled()
+
+
+def test_disabled_path_builds_no_records(monkeypatch):
+    """The acceptance pin: with no sink and no flight ring, spans,
+    events, and histogram observations build NO record dict and call
+    no sink — the record funnel itself is booby-trapped."""
+    from torchdistx_tpu.telemetry import _core
+
+    assert not telemetry.events_enabled()
+
+    def bomb(rec):  # pragma: no cover — the point is it never runs
+        raise AssertionError(f"record built while disabled: {rec}")
+
+    monkeypatch.setattr(_core._state, "record", bomb)
+    monkeypatch.setattr(_core._state, "write_jsonl", bomb)
+    telemetry.event("req.submitted", rid="r1", n_prompt=4)
+    with telemetry.span("serve.step", n=1):
+        pass
+    sp = telemetry.start_span("serve.prefill")
+    sp.end(tokens=3)
+    telemetry.histogram("test.disabled").observe(0.1)
+    assert telemetry.flight_dump("nothing-recorded") == 0
+    assert sp.duration is not None  # spans still time when disabled
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (ISSUE 9)
+
+
+def test_flight_recorder_dumps_to_dedicated_file(tmp_path):
+    flight = tmp_path / "flight.jsonl"
+    telemetry.configure(flight=str(flight), flight_capacity=4)
+    assert not telemetry.enabled()  # no span sink — ring only
+    for i in range(6):  # overflow: ring keeps the most recent 4
+        telemetry.event("req.prefill_chunk", rid=f"r{i}")
+    n = telemetry.flight_dump("RecoveryFailed", rid="r5")
+    assert n == 4
+    recs = [json.loads(line) for line in flight.read_text().splitlines()]
+    assert recs[0]["type"] == "flight_dump"
+    assert recs[0]["reason"] == "RecoveryFailed"
+    assert recs[0]["n"] == 4
+    assert recs[0]["attrs"] == {"rid": "r5"}
+    assert [r["rid"] for r in recs[1:]] == ["r2", "r3", "r4", "r5"]
+    # The ring cleared: back-to-back failures dump disjoint windows.
+    assert telemetry.flight_dump("again") == 0
+
+
+def test_flight_recorder_header_only_into_main_sink(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    telemetry.configure(jsonl=str(path), flight=True)
+    telemetry.event("req.submitted", rid="r0")
+    assert telemetry.flight_dump("forced-fault") == 1
+    telemetry.configure(jsonl=None)
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    kinds = [r["type"] for r in recs]
+    # The event was exported as it happened; the dump adds ONLY the
+    # header marker (no duplicate records).
+    assert kinds.count("event") == 1
+    assert kinds.count("flight_dump") == 1
+
+
+# ---------------------------------------------------------------------------
+# Span-stack depth under concurrency (the tier-1 "span flake" pin)
+
+
+def test_span_depths_exact_under_concurrent_threads():
+    """Depth/parent accounting must stay exact per thread under
+    concurrent load: stacks are thread-local and only the owner mutates
+    them (the PR 1 collector corrupted depths when threads raced)."""
+    telemetry.configure(collect=True, max_spans=100_000)
+    n_threads, n_iters = 8, 200
+    errors = []
+
+    def work(tid):
+        try:
+            for i in range(n_iters):
+                with telemetry.span(f"outer-{tid}"):
+                    with telemetry.span(f"inner-{tid}"):
+                        pass
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=work, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    recs = telemetry.drain()
+    assert len(recs) == n_threads * n_iters * 2
+    for r in recs:
+        tid = r["name"].split("-")[1]
+        if r["name"].startswith("outer"):
+            assert r["depth"] == 0, r
+            assert "parent" not in r, r
+        else:
+            assert r["depth"] == 1, r
+            assert r["parent"] == f"outer-{tid}", r
+
+
+def test_span_ended_on_another_thread_leaves_owner_stack_clean():
+    """A span started on thread A and ended on thread B (a drain span
+    finalized by a reaper) must record once and leave A's nesting stack
+    consistent: A's next span is depth 0, not a phantom child."""
+    telemetry.configure(collect=True)
+    sp = telemetry.start_span("crossing")
+    t = threading.Thread(target=sp.end)
+    t.start()
+    t.join()
+    with telemetry.span("after"):
+        pass
+    by_name = {r["name"]: r for r in telemetry.drain()}
+    assert by_name["crossing"]["thread"] != by_name["after"]["thread"] or True
+    assert by_name["after"]["depth"] == 0
+    assert "parent" not in by_name["after"]
+
+
+def test_detached_span_never_parents():
+    telemetry.configure(collect=True)
+    drain_sp = telemetry.start_span("serve.drain", detached=True)
+    with telemetry.span("serve.step"):
+        pass
+    drain_sp.end()
+    by_name = {r["name"]: r for r in telemetry.drain()}
+    assert "parent" not in by_name["serve.step"]
+    assert by_name["serve.step"]["depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# JSONL schema back-compat (ISSUE 9 acceptance)
+
+
+def test_jsonl_schema_backward_compatible(tmp_path):
+    """Pre-ISSUE-9 consumers parse unchanged: span records keep their
+    keys, counters records keep values/gauges, and the histograms key
+    appears only once a histogram exists."""
+    path = tmp_path / "trace.jsonl"
+    telemetry.configure(jsonl=str(path))
+    with telemetry.span("a.phase", n=1):
+        pass
+    telemetry.counter("test.c").add()
+    telemetry.emit_counters()
+    telemetry.histogram("test.h_schema").observe(0.5)
+    telemetry.emit_counters()
+    telemetry.configure(jsonl=None)
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    span = next(r for r in recs if r["type"] == "span")
+    assert {"type", "name", "ts", "dur_s", "thread", "depth"} <= set(span)
+    counters = [r for r in recs if r["type"] == "counters"]
+    assert {"type", "ts", "values", "gauges"} <= set(counters[0])
+    # The histograms key is ADDITIVE: absent from the first snapshot
+    # (the new histogram didn't exist yet), present once it does.
+    assert "test.h_schema" not in counters[0].get("histograms", {})
+    assert counters[1]["histograms"]["test.h_schema"]["count"] == 1
+
+
+def test_flight_dump_without_any_sink_keeps_the_window(tmp_path):
+    """Ring-only mode with no main sink has nowhere to persist: the
+    dump must NOT destroy the post-mortem window — it returns 0, keeps
+    the records, and a later dump (once a sink exists) delivers them."""
+    telemetry.configure(flight=True)  # ring only: no JSONL, no collector
+    telemetry.event("req.submitted", rid="r0")
+    assert telemetry.flight_dump("nowhere-to-go") == 0
+    # The window survived; route the recorder to a dedicated file and
+    # the SAME records dump.
+    flight = tmp_path / "late-flight.jsonl"
+    telemetry.configure(flight=str(flight))
+    assert telemetry.flight_dump("retry") == 1
+    recs = [json.loads(line) for line in flight.read_text().splitlines()]
+    assert [r["type"] for r in recs] == ["flight_dump", "event"]
+    assert recs[1]["rid"] == "r0"
+
+
+def test_flight_dump_failed_write_keeps_the_window(tmp_path):
+    """An unwritable dedicated flight file must not cost the window:
+    the failed dump returns 0 and the records remain for a retry."""
+    telemetry.configure(flight=str(tmp_path / "no-such-dir" / "f.jsonl"))
+    telemetry.event("req.submitted", rid="r0")
+    assert telemetry.flight_dump("disk-vanished") == 0
+    flight = tmp_path / "flight.jsonl"
+    telemetry.configure(flight=str(flight))
+    assert telemetry.flight_dump("retry") == 1
+    assert flight.exists()
+
+
+def test_flight_dump_backfills_presink_records(tmp_path):
+    """A main-sink dump must not assume the whole window was exported
+    live: records captured before the sink existed are backfilled after
+    the header (exactly once), records the sink already exported are
+    not re-written, and the ring clears only then."""
+    telemetry.configure(flight=True)  # ring only: no sink yet
+    telemetry.event("req.submitted", rid="early")
+    path = tmp_path / "trace.jsonl"
+    telemetry.configure(jsonl=str(path))
+    telemetry.event("req.finished", rid="late")  # exported as it happens
+    assert telemetry.flight_dump("post-mortem") == 2
+    assert telemetry.flight_dump("ring-cleared") == 0
+    telemetry.configure(jsonl=None)
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r.get("rid", r["type"]) for r in recs] == [
+        "late", "flight_dump", "early"
+    ]
+    header = recs[1]
+    assert header["n"] == 2 and header["backfilled"] == 1

@@ -59,6 +59,9 @@ class TestTrainStep:
             results.append(float(m["loss"]))
         assert abs(results[0] - results[1]) < 1e-3
 
+    # tier-1 re-budget (ISSUE 9): heavy, and reproduces the known
+    # jaxlib SPMD breakage at HEAD (ROADMAP item 1).
+    @pytest.mark.slow
     @pytest.mark.parametrize("ring_impl", ["ring", "ring_zigzag"])
     def test_sequence_parallel_matches_single(self, cfg, ring_impl):
         tokens_shape = (8, 64)
@@ -183,6 +186,7 @@ class TestSlowMoTrainStep:
             assert np.allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 9): heavy; slow lane
 def test_zigzag_layout_matches_contiguous(cfg):
     """Whole-model zigzag layout: same loss as the contiguous layout (the
     permutation is a relabeling — RoPE uses original positions, targets

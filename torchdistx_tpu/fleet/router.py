@@ -50,6 +50,7 @@ the ``fleet.swap`` span (:mod:`.hot_swap`).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -77,6 +78,15 @@ _T_SUBMITTED = _telemetry.counter("fleet.submitted")
 _T_FAILOVERS = _telemetry.counter("fleet.failovers")
 _T_HOPS_EXHAUSTED = _telemetry.counter("fleet.hops_exhausted")
 _G_REPLICAS_READY = _telemetry.gauge("fleet.replicas_ready")
+# Wall-clock a failover adds to its stream: from catching the replica's
+# typed failure to the successful re-submission on a peer (backoff
+# sleeps included — they are part of what the consumer waits).
+_H_FAILOVER_ADDED = _telemetry.histogram("fleet.failover_added_s")
+
+# Fleet-wide trace-id mint ("fleet-r0", "fleet-r1", ...): ONE id pinned
+# at fleet submission and forwarded on every failover hop, so every
+# engine's spans/events for the request reconstruct into one timeline.
+_TRACE_SEQ = itertools.count()
 
 # Health states a replica may be routed to.  DRAINING/STOPPED are
 # excluded outright; OVERLOADED is routable but avoided (last resort).
@@ -169,6 +179,9 @@ class FleetHandle:
         self.hops = 0  # re-submissions consumed (first binding is free)
         self.replica_id: Optional[int] = None
         self.version: Optional[str] = None
+        # Trace context: minted at first bind (lazily — only once
+        # something is recording) and forwarded on every hop.
+        self.trace_id: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -190,8 +203,28 @@ class FleetHandle:
     # Binding / failover
 
     def _fail(self, error: BaseException) -> None:
+        if self._done:
+            # Idempotent: a deadline that expires during placement is
+            # failed by _remaining_deadline_s AND re-caught by the bind
+            # loop — one terminal event, not two.
+            return
         self.error = error
         self._done = True
+        if self.trace_id is not None:
+            _telemetry.event(
+                "req.failed",
+                rid=self.trace_id,
+                engine="fleet",
+                hop=self.hops,
+                error=type(error).__name__,
+                retryable=bool(getattr(error, "retryable", False)),
+                n_tokens=len(self._committed),
+            )
+        if isinstance(error, (FailoverExhausted, FailoverDiverged,
+                              NoReplicaAvailable)):
+            # Fleet-terminal infrastructure failures are flight-recorder
+            # moments: the ring holds the hops that led here.
+            _telemetry.flight_dump(type(error).__name__, rid=self.trace_id)
 
     def _remaining_deadline_s(self) -> Optional[float]:
         if self._deadline is None:
@@ -218,6 +251,9 @@ class FleetHandle:
         # weights version — never interleave two models in one stream.
         version = self.version if self._committed else None
         retry = self._router.retry
+        t_fail = time.perf_counter() if cause is not None else None
+        if self.trace_id is None and _telemetry.events_enabled():
+            self.trace_id = f"fleet-r{next(_TRACE_SEQ)}"
         while True:
             if cause is not None:
                 self.hops += 1
@@ -259,6 +295,8 @@ class FleetHandle:
                     deadline_s=self._remaining_deadline_s(),
                     tenant=self.tenant,
                     priority=self.priority,
+                    trace_id=self.trace_id,
+                    hop=self.hops,
                 )
             except RequestError as err:
                 if not retry.is_retryable(err):
@@ -266,11 +304,28 @@ class FleetHandle:
                     raise
                 excluded.add(rep.rid)
                 cause = err
+                if t_fail is None:
+                    # The binding's FIRST failure was a synchronous
+                    # rejection (not a mid-stream failure): the added-
+                    # latency clock starts here.
+                    t_fail = time.perf_counter()
                 continue
             self.replica_id = rep.rid
             self.version = rep.version
             if cause is not None:
                 _T_FAILOVERS.add()
+                added = time.perf_counter() - t_fail
+                _H_FAILOVER_ADDED.observe(added)
+                if self.trace_id is not None:
+                    _telemetry.event(
+                        "req.failover_hop",
+                        rid=self.trace_id,
+                        engine=getattr(rep.engine, "engine_id", None),
+                        hop=self.hops,
+                        cause=type(cause).__name__,
+                        added_s=round(added, 6),
+                        n_tokens=len(self._committed),
+                    )
             return
 
     # ------------------------------------------------------------------
@@ -526,6 +581,23 @@ class FleetRouter:
             tenant=tenant,
             priority=priority,
         )
+        if _telemetry.events_enabled():
+            # The fleet-level submission opens the request's timeline —
+            # even one that expires or fails before any engine accepts
+            # it reconstructs complete (engine-side re-submissions emit
+            # their own hop-scoped req.submitted as they land).
+            handle.trace_id = f"fleet-r{next(_TRACE_SEQ)}"
+            _telemetry.event(
+                "req.submitted",
+                rid=handle.trace_id,
+                engine="fleet",
+                hop=0,
+                n_prompt=len(handle._prompt),
+                max_new=int(max_new_tokens),
+                tenant=handle.tenant,
+                priority=handle.priority,
+                deadline_s=deadline_s,
+            )
         _T_SUBMITTED.add()
         try:
             handle._bind()

@@ -83,6 +83,19 @@ re-admission).  Both resume token-identically — ``fold_in(key, n_gen)``
 again — and ``scheduler="fifo"`` (the default) leaves every existing
 behavior byte-identical.
 
+**Observability** (docs/observability.md): every request carries a
+trace context (``trace_id``/``engine``/``hop``) and emits a lifecycle
+event stream (``req.submitted → req.queued → req.admitted →
+req.prefill_chunk×N → req.first_token → req.preempted/req.swapped/
+req.resumed → req.finished | req.failed``) that
+``scripts/trace_report.py`` reconstructs into per-request timelines;
+latency distributions (queue wait, prefill, TTFT, per-token decode,
+preemption outage) land in per-engine labeled telemetry histograms —
+``stats()`` reads its percentiles from them — and the crash-recovery
+supervisor dumps the telemetry flight recorder before every replay
+pass.  All of it is free when nothing records: no events, no trace-id
+formatting, no record dicts.
+
 Fault sites (``TDX_FAULT``): ``serve.admit`` and ``serve.prefill`` —
 ``io``/``nan`` requeue at the FIFO head and the next tick retries;
 ``serve.step`` — ``io`` leaves state untouched (tick retries), ``nan``
@@ -97,8 +110,9 @@ drop-and-replay.  ``fatal`` propagates everywhere: fatal means fatal.
 
 from __future__ import annotations
 
+import itertools
 import time
-from collections import deque
+from contextlib import nullcontext
 from functools import partial
 from typing import Any, Optional
 
@@ -158,6 +172,11 @@ _G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
 _G_TTFT = _telemetry.gauge("serve.ttft_s")
 _G_EST_TTFT = _telemetry.gauge("serve.est_ttft_s")
 _G_HEALTH = _telemetry.gauge("serve.health")
+
+# Process-wide engine-id mint: every Engine gets a stable label
+# ("eng0", "eng1", ...) for its per-engine metrics and trace context,
+# unless the caller names it (Engine(engine_id="replica-a")).
+_ENGINE_SEQ = itertools.count()
 
 
 @partial(
@@ -312,6 +331,16 @@ class Engine:
     drain_deadline_s : wall-clock budget for in-flight work once a drain
         begins; the remainder fails with
         :class:`.lifecycle.RequestPreempted` (retryable).
+    engine_id : stable label for this engine's per-engine metrics
+        (``serve.health{engine=...}``, the latency histograms) and its
+        trace context (docs/observability.md).  Default: a process-wide
+        mint ("eng0", "eng1", ...).  In a fleet, name replicas so traces
+        read well — and REUSE the retired replica's id when respawning:
+        labeled instruments live in the process-wide registry for the
+        process lifetime (standard label-cardinality economics), so a
+        churn of fresh ids grows the registry and every exported
+        counters snapshot, while a reused id continues the same
+        instruments.
     handle_preemption : install the SIGTERM/SIGINT flag handlers
         (:mod:`torchdistx_tpu.resilience.preemption`) so a preemption
         signal drains the engine; programmatic notice goes through
@@ -350,9 +379,14 @@ class Engine:
         max_recoveries: int = 2,
         drain_deadline_s: float = 30.0,
         handle_preemption: bool = True,
+        engine_id: Optional[str] = None,
     ):
         self.model = model
         self.cfg = cfg
+        self.engine_id = (
+            str(engine_id) if engine_id is not None
+            else f"eng{next(_ENGINE_SEQ)}"
+        )
         if num_slots < 1:
             # Zero slots would park every request at the FIFO head with
             # no slot ever freeing — tokens() would spin step() forever.
@@ -475,9 +509,30 @@ class Engine:
         self._n_preempt_swap = 0
         self._n_preempt_replay = 0
         self._n_cow = 0
-        # Bounded: stats() reports percentiles over the most recent
-        # window, and a long-lived engine must not grow per-request state.
-        self._ttft = deque(maxlen=4096)
+
+        # Per-engine labeled metrics (docs/observability.md): N fleet
+        # replicas in one process each get their own readings instead of
+        # clobbering the process-global gauges (which are still set, for
+        # back-compat, by whichever engine ticked last — and cleared at
+        # STOPPED so a router never load-balances on a dead engine's
+        # leavings; the labeled gauge needs no such workaround, its final
+        # "stopped" reading is unambiguous).  Histograms are bounded
+        # fixed-bucket state — a long-lived engine does not grow
+        # per-request lists — and always accumulate, sink or no sink:
+        # stats() reads its percentiles from them.
+        eid = self.engine_id
+        self._lg_health = _telemetry.gauge("serve.health", engine=eid)
+        self._lg_est_ttft = _telemetry.gauge("serve.est_ttft_s", engine=eid)
+        self._lg_running = _telemetry.gauge("serve.running_slots", engine=eid)
+        self._h_queue_wait = _telemetry.histogram(
+            "serve.queue_wait_s", engine=eid
+        )
+        self._h_prefill = _telemetry.histogram("serve.prefill_s", engine=eid)
+        self._h_ttft = _telemetry.histogram("serve.ttft_s", engine=eid)
+        self._h_tpot = _telemetry.histogram("serve.tpot_s", engine=eid)
+        self._h_outage = _telemetry.histogram(
+            "serve.preempt_outage_s", engine=eid
+        )
 
         self._drain_t0: Optional[float] = None
         self._drain_sp = None
@@ -487,6 +542,32 @@ class Engine:
             _preemption.install()
         self._health = Health.STARTING
         _G_HEALTH.set(self._health.value)
+        self._lg_health.set(self._health.value)
+
+    # ------------------------------------------------------------------
+    # Request tracing (docs/observability.md, "Request tracing")
+
+    def _event(self, name: str, req: Request, **attrs) -> None:
+        """Emit one request-lifecycle event carrying the trace context.
+        Free for untraced requests: ``trace_id`` stays None when nothing
+        was recording at submit, and the guard here is one attribute
+        read — no record dict, no string formatting."""
+        if req.trace_id is None:
+            return
+        _telemetry.event(
+            name, rid=req.trace_id, engine=self.engine_id, hop=req.hop,
+            **attrs,
+        )
+
+    def _trace_ctx(self, req: Request):
+        """Context manager stamping ``rid``/``engine``/``hop`` onto every
+        span started inside (the serve.prefill chunk spans); a no-op
+        nullcontext for untraced requests."""
+        if req.trace_id is None:
+            return nullcontext()
+        return _telemetry.tracing(
+            rid=req.trace_id, engine=self.engine_id, hop=req.hop
+        )
 
     # ------------------------------------------------------------------
     # Submission / draining
@@ -500,8 +581,17 @@ class Engine:
         deadline_s: Optional[float] = None,
         tenant: str = "default",
         priority: int = 0,
+        trace_id: Optional[str] = None,
+        hop: int = 0,
     ) -> RequestHandle:
         """Queue a request; returns its streaming handle.
+
+        ``trace_id`` / ``hop``: the request-scoped trace context (see
+        docs/observability.md).  A router forwards ONE id across every
+        failover hop (``hop`` counts re-submissions) so the hops
+        reconstruct into a single timeline; left unset, the engine mints
+        ``"{engine_id}-r{rid}"`` — lazily, only when something is
+        recording, so the disabled path formats no strings.
 
         ``key``: an int seed or a PRNG key array — the SAME key a solo
         ``generate(params, prompt[None], key, ...)`` call would take, for
@@ -638,13 +728,23 @@ class Engine:
         deadline = (
             time.perf_counter() + deadline_s if deadline_s is not None else None
         )
-        self.scheduler.push(
-            Request(
-                rid, prompt, int(max_new_tokens), key, handle,
-                deadline=deadline, n_chunks=n_chunks, hashes=hashes,
-                tenant=tenant, priority=priority,
-            )
+        if trace_id is None and _telemetry.events_enabled():
+            trace_id = f"{self.engine_id}-r{rid}"
+        req = Request(
+            rid, prompt, int(max_new_tokens), key, handle,
+            deadline=deadline, n_chunks=n_chunks, hashes=hashes,
+            tenant=tenant, priority=priority,
+            trace_id=trace_id, hop=int(hop),
         )
+        handle._req = req
+        self._event(
+            "req.submitted", req,
+            n_prompt=len(prompt), max_new=int(max_new_tokens),
+            tenant=tenant, priority=priority,
+            deadline_s=deadline_s, n_chunks=n_chunks,
+        )
+        self.scheduler.push(req)
+        self._event("req.queued", req, queue_depth=len(self.scheduler))
         _T_REQUESTS.add()
         return handle
 
@@ -695,6 +795,9 @@ class Engine:
         if health is not self._health:
             self._health = health
             _G_HEALTH.set(health.value)
+            # The labeled gauge keeps its final reading at STOPPED — per-
+            # engine scoping needs no clear-on-STOPPED workaround.
+            self._lg_health.set(health.value)
 
     def _n_running(self) -> int:
         return sum(r is not None for r in self._slot_req)
@@ -754,8 +857,12 @@ class Engine:
         if self._health is not Health.STOPPED:
             _G_HEALTH.set(self._health.value)
             if self.detector.enabled:
-                _G_EST_TTFT.set(round(self.est_ttft_s(), 4))
-        _G_RUNNING.set(self._n_running())
+                est = round(self.est_ttft_s(), 4)
+                _G_EST_TTFT.set(est)
+                self._lg_est_ttft.set(est)
+        n_run = self._n_running()
+        _G_RUNNING.set(n_run)
+        self._lg_running.set(n_run)
 
     # ------------------------------------------------------------------
     # Lifecycle: reap, drain
@@ -822,6 +929,7 @@ class Engine:
         self._drain_t0 = time.perf_counter()
         self._drain_sp = _telemetry.start_span(
             "serve.drain",
+            detached=True,
             n_running=self._n_running(),
             n_waiting=len(self.scheduler),
         )
@@ -1006,6 +1114,11 @@ class Engine:
         for slot in list(self._prefill_q):
             req = self._abort_prefill(slot)
             req.n_chunks = self._replay_chunks(req)
+            req.preempt_t = time.perf_counter()
+            self._event(
+                "req.preempted", req, mechanism="replay",
+                reason="prefill_requeue", n_tokens=0,
+            )
             self.scheduler.push(req)
             self._n_preempt_replay += 1
             _T_PREEMPT_REPLAY.add()
@@ -1128,6 +1241,12 @@ class Engine:
                 self._done[slot] = True
                 self._n_preempt_swap += 1
                 _T_PREEMPT_SWAP.add()
+                req.preempt_t = time.perf_counter()
+                self._event(
+                    "req.swapped", req, n_private=len(priv),
+                    n_shared=len(layout) - len(priv),
+                    n_tokens=len(req.handle._tokens),
+                )
                 return
         # Drop-and-replay (the swap fallback lands here too).
         if slot in self._swapped:
@@ -1137,6 +1256,11 @@ class Engine:
         self._reset_prefill_state(req)
         req.n_chunks = self._replay_chunks(req)
         self._clear_slot(slot)
+        req.preempt_t = time.perf_counter()
+        self._event(
+            "req.preempted", req, mechanism="replay", reason="pressure",
+            n_tokens=len(req.handle._tokens),
+        )
         self.scheduler.push(req)
         self._n_preempt_replay += 1
         _T_PREEMPT_REPLAY.add()
@@ -1210,6 +1334,13 @@ class Engine:
             req.table = table
             self._tables[slot] = table
             self._done[slot] = False
+            if req.preempt_t is not None:
+                self._h_outage.observe(time.perf_counter() - req.preempt_t)
+                req.preempt_t = None
+            self._event(
+                "req.resumed", req, mechanism="swap",
+                n_tokens=len(req.handle._tokens),
+            )
 
     # ------------------------------------------------------------------
     # Chunked prefill + the prefix cache
@@ -1293,6 +1424,16 @@ class Engine:
         # until the last chunk installs them — the decode batch must not
         # see a half-prefilled slot.
         self._prefill_q.append(slot)
+        if req.admit_t is None:
+            # First admission only: the queue-wait phase ends here.  A
+            # re-admission (drop-and-replay resume, transient-failure
+            # requeue) is preemption outage, not queue wait.
+            req.admit_t = time.perf_counter()
+            self._h_queue_wait.observe(req.admit_t - req.submit_t)
+        self._event(
+            "req.admitted", req, slot=slot, cached_tokens=cached_len,
+            n_blocks=len(req.blocks),
+        )
 
     def _advance_prefills(self) -> None:
         """Dispatch up to ``max_prefills_per_tick`` prefill chunks,
@@ -1401,7 +1542,11 @@ class Engine:
         included) would write, then run it."""
         bucket = self._chunk_bucket(end - start)
         self._cow_shared_pages(req, start, start + bucket)
-        with _telemetry.span(
+        self._event(
+            "req.prefill_chunk", req, start=start, n=end - start,
+            last=end >= len(seq),
+        )
+        with self._trace_ctx(req), _telemetry.span(
             "serve.prefill", slot=slot, start=start, n=end - start,
             bucket=bucket, cached=req.n_cached,
         ):
@@ -1417,6 +1562,7 @@ class Engine:
                 self.allocator,
             )
         toks = req.handle._tokens
+        now = time.perf_counter()
         if toks:
             # A drop-and-replay preemption victim resuming: the sampled
             # token is a recomputation of an already-committed one —
@@ -1430,9 +1576,25 @@ class Engine:
             self._keys[slot] = req.key
             self._tables[slot] = req.table
             self._emitted[slot] = len(toks)
+            if req.preempt_t is not None:
+                self._h_outage.observe(now - req.preempt_t)
+                req.preempt_t = None
+            self._event(
+                "req.resumed", req, mechanism="replay", n_tokens=len(toks)
+            )
             return
-        req.handle.ttft_s = time.perf_counter() - req.submit_t
-        self._ttft.append(req.handle.ttft_s)
+        req.handle.ttft_s = now - req.submit_t
+        self._h_ttft.observe(req.handle.ttft_s)
+        if req.preempt_t is not None:
+            # Preempted (or recovery-requeued) before its first token:
+            # the stall since then is outage, not prefill.
+            self._h_outage.observe(now - req.preempt_t)
+            req.preempt_t = None
+        elif req.admit_t is not None:
+            self._h_prefill.observe(now - req.admit_t)
+        self._event(
+            "req.first_token", req, ttft_s=round(req.handle.ttft_s, 6)
+        )
         _G_TTFT.set(round(req.handle.ttft_s, 4))
         s = len(req.prompt)
         self._tokens[slot] = first
@@ -1607,6 +1769,11 @@ class Engine:
                 self._positions[slot] += self.decode_chunk
                 self._n_gen[slot] += self.decode_chunk
         self._decode_tokens += committed
+        if committed:
+            # Per-token decode time (TPOT): one aggregated observation
+            # per chunk — each committed token cost one scan step of
+            # this chunk's wall time.  No per-token call, no allocation.
+            self._h_tpot.observe(dt / self.decode_chunk, n=committed)
         if self._decode_s > 0:
             _G_DECODE_TPS.set(round(self._decode_tokens / self._decode_s, 1))
         sp.end(tokens=committed)
@@ -1635,15 +1802,28 @@ class Engine:
         """
         self._n_recoveries += 1
         _T_RECOVERIES.add()
+        # The post-mortem moment the flight recorder exists for: dump
+        # the recent-records ring before the replay overwrites history.
+        _telemetry.flight_dump(
+            "serve.recover", engine=self.engine_id,
+            error=type(error).__name__,
+        )
         sp = _telemetry.start_span(
             "serve.recover",
             n_live=self._n_running(),
             error=type(error).__name__,
         )
+        now = time.perf_counter()
         for slot in range(self.num_slots):
             req = self._slot_req[slot]
             if req is not None:
                 req.recoveries += 1
+                if req.preempt_t is None:
+                    req.preempt_t = now
+                self._event(
+                    "req.preempted", req, mechanism="replay",
+                    reason="recovery", n_tokens=len(req.handle._tokens),
+                )
         # Slots still PREFILLING have no committed tokens to replay:
         # their (lost) pages come back with the allocator reset below,
         # and the requests restart from the FIFO head — in admission
@@ -1761,6 +1941,13 @@ class Engine:
         self._keys[slot] = req.key
         self._tables[slot] = table
         self._emitted[slot] = n_gen
+        if req.preempt_t is not None:
+            self._h_outage.observe(time.perf_counter() - req.preempt_t)
+            req.preempt_t = None
+        self._event(
+            "req.resumed", req, mechanism="replay", reason="recovery",
+            n_tokens=n_gen,
+        )
 
     # ------------------------------------------------------------------
     # Token commit / retirement
@@ -1832,8 +2019,22 @@ class Engine:
             out["decode_tokens_per_s"] = round(
                 self._decode_tokens / self._decode_s, 1
             )
-        if self._ttft:
-            t = np.asarray(self._ttft)
-            out["ttft_p50_s"] = round(float(np.percentile(t, 50)), 4)
-            out["ttft_p95_s"] = round(float(np.percentile(t, 95)), 4)
+        # Latency percentiles come from the per-engine telemetry
+        # histograms (the ad-hoc bounded lists they replaced could not
+        # be shared with the trace/export layer): exact counts, ~33%
+        # bucket resolution, O(1) state however long the engine lives.
+        if self._h_ttft.count:
+            out["ttft_p50_s"] = round(self._h_ttft.percentile(50), 4)
+            out["ttft_p95_s"] = round(self._h_ttft.percentile(95), 4)
+        if self._h_tpot.count:
+            out["tpot_p50_s"] = round(self._h_tpot.percentile(50), 6)
+            out["tpot_p95_s"] = round(self._h_tpot.percentile(95), 6)
+        if self._h_queue_wait.count:
+            out["queue_wait_p95_s"] = round(
+                self._h_queue_wait.percentile(95), 4
+            )
+        if self._h_outage.count:
+            out["preempt_outage_p95_s"] = round(
+                self._h_outage.percentile(95), 4
+            )
         return out

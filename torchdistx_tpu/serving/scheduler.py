@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 from .blocks import BlockAllocator, blocks_needed
+from .lifecycle import RecoveryFailed
 
 __all__ = ["FIFOScheduler", "Request", "RequestHandle"]
 
@@ -71,6 +72,16 @@ class Request:
     # QoS context (inert under the FIFO scheduler; see qos.py):
     tenant: str = "default"  # fair-queueing share owner
     priority: int = 0  # priority class — higher admits (and preempts) first
+    # Trace context (see docs/observability.md, "Request tracing"): the
+    # request-scoped id every req.* lifecycle event and serve.* span
+    # carries.  A fleet submission pins one id across every failover hop
+    # (hop counts re-submissions); a standalone engine mints
+    # "{engine_id}-r{rid}" lazily, only once something is recording.
+    trace_id: Optional[str] = None
+    hop: int = 0
+    # Phase-timing marks (engine-owned; feed the latency histograms):
+    admit_t: Optional[float] = None  # first admission (queue-wait end)
+    preempt_t: Optional[float] = None  # outage start (preempt/recovery)
 
     @property
     def cache_tokens(self) -> int:
@@ -107,6 +118,7 @@ class RequestHandle:
     def __init__(self, engine, rid: int):
         self._engine = engine
         self.rid = rid
+        self._req = None  # back-ref for lifecycle events (engine sets it)
         self._tokens: List[int] = []
         self._done = False
         self._cancel_requested = False
@@ -131,8 +143,26 @@ class RequestHandle:
     def _push(self, token: int) -> None:
         self._tokens.append(token)
 
+    def _event(self, name: str, **attrs) -> None:
+        """Emit a lifecycle event for this request — the ONE funnel for
+        terminal events, so every failure path (shed, drain flush,
+        expiry, cancel, recovery exhaustion) closes the timeline without
+        each call site remembering to.  Free for untraced requests
+        (``trace_id`` stays None when nothing was recording at submit)."""
+        req = self._req
+        if req is None or req.trace_id is None:
+            return
+        _telemetry.event(
+            name,
+            rid=req.trace_id,
+            engine=getattr(self._engine, "engine_id", None),
+            hop=req.hop,
+            **attrs,
+        )
+
     def _finish(self) -> None:
         self._done = True
+        self._event("req.finished", n_tokens=len(self._tokens))
 
     def _fail(self, error: BaseException) -> None:
         """Abort the request with a typed error (see :mod:`.lifecycle`):
@@ -140,6 +170,18 @@ class RequestHandle:
         stream."""
         self.error = error
         self._done = True
+        self._event(
+            "req.failed",
+            error=type(error).__name__,
+            retryable=bool(getattr(error, "retryable", False)),
+            n_tokens=len(self._tokens),
+        )
+        if isinstance(error, RecoveryFailed):
+            # Recovery exhaustion is exactly the post-mortem the flight
+            # recorder exists for: dump the recent-records ring.
+            _telemetry.flight_dump(
+                "RecoveryFailed", rid=self._req.trace_id if self._req else None
+            )
 
     def tokens(self) -> Iterator[int]:
         """Yield tokens as they are produced, stepping the engine while

@@ -27,6 +27,7 @@ Instrumenting your own code::
 """
 
 from ._core import (  # noqa: F401
+    Histogram,
     Span,
     configure,
     counter,
@@ -34,15 +35,22 @@ from ._core import (  # noqa: F401
     drain,
     emit_counters,
     enabled,
+    event,
+    events_enabled,
+    flight_dump,
     gauge,
     gauges,
+    histogram,
+    histograms,
     reset,
     snapshot,
     span,
     start_span,
+    tracing,
 )
 
 __all__ = [
+    "Histogram",
     "Span",
     "configure",
     "counter",
@@ -50,10 +58,16 @@ __all__ = [
     "drain",
     "emit_counters",
     "enabled",
+    "event",
+    "events_enabled",
+    "flight_dump",
     "gauge",
     "gauges",
+    "histogram",
+    "histograms",
     "reset",
     "snapshot",
     "span",
     "start_span",
+    "tracing",
 ]
