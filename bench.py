@@ -549,11 +549,17 @@ def bench_serving():
     )
     from trace_report import reconstruct
 
+    from torchdistx_tpu.telemetry import ops as tdx_ops
+
     # Collect the run's own trace in memory: the reconstruction below
     # reads the SAME event stream a production TDX_TELEMETRY trace
     # carries (restored to the caller's settings at the end).
     prev_telemetry = telemetry.configure(collect=True, max_spans=65536)
     telemetry.drain()
+    # Per-tick utilization attribution WITHOUT an HTTP listener: the
+    # drive loop below samples serve.occupancy / serve.goodput each tick
+    # for the utilization numbers (restored at the end).
+    prev_attr = tdx_ops.enable_tick_attribution(True)
 
     cfg = llama.LlamaConfig(
         vocab_size=32000, dim=1024, n_layers=16, n_heads=16, n_kv_heads=16,
@@ -599,6 +605,13 @@ def bench_serving():
 
     def run_trace(eng, trace_prompts, trace_outs, trace_arrival):
         peak_util = 0.0
+        # The per-tick attribution gauges (docs/observability.md, "Ops
+        # plane"), sampled every tick: mean decode-batch occupancy and
+        # mean goodput over the ticks that actually decoded.
+        g_occ = telemetry.gauge("serve.occupancy", engine=eng.engine_id)
+        g_good = telemetry.gauge("serve.goodput", engine=eng.engine_id)
+        occ_sum = good_sum = 0.0
+        decode_ticks = 0
         t0 = time.perf_counter()
         i, tick = 0, 0
         n = len(trace_prompts)
@@ -611,7 +624,18 @@ def bench_serving():
             eng.step()
             tick += 1
             peak_util = max(peak_util, eng.allocator.utilization())
-        return time.perf_counter() - t0, peak_util, eng.stats()
+            occ = g_occ.value or 0.0
+            if occ > 0:
+                decode_ticks += 1
+                occ_sum += occ
+                good_sum += g_good.value or 0.0
+        st = eng.stats()
+        if decode_ticks:
+            st["mean_decode_batch_occupancy"] = round(
+                occ_sum / decode_ticks, 4
+            )
+            st["goodput_tokens_per_s"] = round(good_sum / decode_ticks, 1)
+        return time.perf_counter() - t0, peak_util, st
 
     telemetry.drain()  # warm-up records are not the measured trace
     eng = make_engine()
@@ -653,6 +677,10 @@ def bench_serving():
             "ttft_p95_s": p_st.get("ttft_p95_s"),
             "sustained_decode_tokens_per_s": p_st.get("decode_tokens_per_s"),
             "peak_block_utilization": round(p_peak, 4),
+            "mean_decode_batch_occupancy": p_st.get(
+                "mean_decode_batch_occupancy"
+            ),
+            "goodput_tokens_per_s": p_st.get("goodput_tokens_per_s"),
         }
         if cache_on:
             row["prefix_hit_rate"] = round(p_st["prefix_hits"] / n_req, 3)
@@ -804,6 +832,7 @@ def bench_serving():
         3,
     )
 
+    tdx_ops.enable_tick_attribution(prev_attr)
     telemetry.configure(**prev_telemetry)
     return {
         "n_requests": n_req,
@@ -822,6 +851,11 @@ def bench_serving():
         "tpot_p50_s": st.get("tpot_p50_s"),
         "tpot_p95_s": st.get("tpot_p95_s"),
         "peak_block_utilization": round(peak_util, 4),
+        # Per-tick utilization attribution (ISSUE 10): how full the
+        # decode batch ran, and committed decode tokens per tick-second
+        # — the serving analogue of train-side MFU.
+        "mean_decode_batch_occupancy": st.get("mean_decode_batch_occupancy"),
+        "goodput_tokens_per_s": st.get("goodput_tokens_per_s"),
         # The run's own reconstructed timelines (scripts/trace_report.py):
         # every request must reconstruct complete, and the phase totals
         # say where the wall time went.

@@ -32,11 +32,30 @@ preempt-and-resume or fail typed; at drain: zero leaked pages, zero
 refcount drift, zero phantom swapped pages, and ``serve.preemptions_*``
 visible in the trace.
 
+Phase 1.7 — wedge (ISSUE 10 acceptance gate): a dedicated engine on a
+tight-deadline ops plane has its tick loop deliberately stopped with
+in-flight work.  The stall watchdog must detect it within the deadline,
+flight-dump ``reason=stall``, set ``serve.stalled``/503 ``/healthz``,
+and mark the engine OVERLOADED; resuming ticks must clear the latch and
+finish the stream token-identical, and ``Engine.close()`` must tear the
+listener down (connection refused).
+
 Phase 2 — drain: under live load, a real SIGTERM goes through the real
 handler chain.  The engine must reach STOPPED within the drain deadline,
 finishing in-flight work or failing it with a retryable typed error —
 completed streams are re-checked against solo generate() (no silent
 truncation).
+
+Throughout (ISSUE 10): every soak engine joins one live ops plane
+(``Engine(ops_port=...)``); ``/metrics`` is scraped mid-soak inside the
+drive loops and at every phase boundary, each scrape validated as
+Prometheus text exposition (TYPE-before-sample, cumulative buckets,
+``+Inf`` == ``_count``) with coherent per-tick attribution (occupancy /
+prefill budget / page util in [0, 1], goodput > 0 observed while
+decoding), and the per-tenant queue-depth family must be pruned from
+the scrape once tenants idle.  Fleet mode adds a wedged-replica
+segment: the watchdog marks the replica OVERLOADED and the router must
+route around it, then readmit it after recovery.
 
 Finally the exported telemetry trace must record the recoveries: the
 ``serve.recover`` and ``serve.drain`` spans and a
@@ -64,6 +83,7 @@ import json
 import os
 import signal
 import sys
+import time
 
 # Runnable from a checkout without installation.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -80,8 +100,9 @@ def fail(msg: str) -> int:
 
 
 def parse_trace(path):
-    """Span names + merged counter snapshots from a JSONL trace."""
-    spans, counters = set(), {}
+    """Span names + merged counter snapshots + flight-dump reasons from
+    a JSONL trace."""
+    spans, counters, dumps = set(), {}, []
     with open(path) as f:
         for line in f:
             rec = json.loads(line)
@@ -89,7 +110,70 @@ def parse_trace(path):
                 spans.add(rec["name"])
             elif rec.get("type") == "counters":
                 counters.update(rec.get("values", {}))
-    return spans, counters
+            elif rec.get("type") == "flight_dump":
+                dumps.append(rec.get("reason"))
+    return spans, counters, dumps
+
+
+def check_exposition(text):
+    """Validate a /metrics scrape as Prometheus text exposition: every
+    line parses, TYPE is declared once and before its family's samples,
+    histogram buckets are cumulative with ``+Inf`` == ``_count``.
+    Returns ``{sample_name: [(labels, value)]}``."""
+    import re
+
+    fams, samples = {}, {}
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("#"):
+            p = ln.split()
+            assert p[:2] == ["#", "TYPE"] and len(p) == 4, f"bad comment: {ln!r}"
+            assert p[2] not in fams, f"duplicate TYPE: {p[2]}"
+            fams[p[2]] = p[3]
+            continue
+        m = re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$', ln)
+        assert m, f"unparseable sample: {ln!r}"
+        name, lbl, val = m.group(1), m.group(2) or "", m.group(3)
+        fam = name
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[: -len(suf)] in fams:
+                fam = name[: -len(suf)]
+        assert fam in fams, f"sample before its TYPE: {ln!r}"
+        labels = dict(re.findall(r'([a-zA-Z0-9_:]+)="((?:[^"\\]|\\.)*)"', lbl))
+        samples.setdefault(name, []).append((labels, float(val)))
+    for fam, kind in fams.items():
+        if kind != "histogram":
+            continue
+        series, counts = {}, {}
+        for labels, v in samples.get(fam + "_count", []):
+            counts[tuple(sorted(labels.items()))] = v
+        for labels, v in samples.get(fam + "_bucket", []):
+            key = tuple(sorted((k, x) for k, x in labels.items() if k != "le"))
+            series.setdefault(key, []).append((labels["le"], v))
+        for key, buckets in series.items():
+            vals = [v for _, v in buckets]
+            assert vals == sorted(vals), f"{fam}: buckets not cumulative"
+            inf = [v for le, v in buckets if le == "+Inf"]
+            assert inf and inf[0] == counts[key], f"{fam}: +Inf != _count"
+    return samples
+
+
+def pick(samples, name, **labels):
+    """First sample of ``name`` whose labels include ``labels``."""
+    for slabels, value in samples.get(name, []):
+        if all(slabels.get(k) == str(v) for k, v in labels.items()):
+            return value
+    return None
+
+
+def scrape(url):
+    """GET /metrics and validate the exposition; returns the samples."""
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        assert r.status == 200, f"/metrics returned {r.status}"
+        return check_exposition(r.read().decode())
 
 
 def main() -> int:
@@ -109,10 +193,43 @@ def main() -> int:
     from torchdistx_tpu.models.generate import generate
     from torchdistx_tpu.resilience import faults
     from torchdistx_tpu.serving import Engine, Health, RequestError
+    from torchdistx_tpu.telemetry import ops as tdx_ops
 
     cfg = llama.llama_test()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     rng = np.random.default_rng(SEED)
+
+    # The live ops plane, shared by every soak engine: /metrics is
+    # scraped and format-validated mid-soak at every phase boundary and
+    # periodically inside the drive loops.  The shared plane's watchdog
+    # deadline is generous (compile stalls are real but not wedges);
+    # the deliberate-wedge phase below runs its own tight plane.
+    plane = tdx_ops.get_plane(
+        0, tdx_ops.OpsConfig(stall_deadline_s=60.0)
+    ).retain()
+    ops_url = plane.server.url
+    attr_seen = {"goodput": False, "scrapes": 0}
+
+    def scrape_check(eng):
+        """One validated mid-soak scrape + attribution coherence."""
+        samples = scrape(ops_url)
+        attr_seen["scrapes"] += 1
+        eid = eng.engine_id
+        occ = pick(samples, "serve_occupancy", engine=eid)
+        if occ is not None:
+            assert 0 <= occ <= 1, f"occupancy {occ} out of range"
+            budget = pick(samples, "serve_prefill_budget", engine=eid)
+            util = pick(samples, "serve_page_util", engine=eid)
+            goodput = pick(samples, "serve_goodput", engine=eid)
+            assert 0 <= budget <= 1, f"prefill budget {budget} out of range"
+            assert 0 <= util <= 1, f"page util {util} out of range"
+            assert goodput >= 0
+            # "goodput > 0 while decoding": a fault-skipped tick can
+            # decode nothing, so the gate is cumulative — some scrape
+            # must catch the engine mid-decode.
+            if occ > 0 and goodput > 0:
+                attr_seen["goodput"] = True
+        return samples
 
     solo_cache = {}
 
@@ -169,6 +286,7 @@ def main() -> int:
             params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
             block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
             max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+            ops_port=plane.port,
         )
 
     # ---------------- Phase 1: the soak ----------------
@@ -185,12 +303,15 @@ def main() -> int:
             h.cancel()
         reqs.append((prompt, mnt, i, h))
 
-    for _ in range(MAX_STEPS):
+    for tick in range(MAX_STEPS):
         if not (len(eng.scheduler) or eng._n_running()):
             break
         eng.step()
+        if tick % 25 == 10:
+            scrape_check(eng)
     else:
         return fail(f"soak did not drain within {MAX_STEPS} steps (hang)")
+    scrape_check(eng)
 
     n_ok = n_typed = 0
     for prompt, mnt, key, h in reqs:
@@ -237,6 +358,7 @@ def main() -> int:
         block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
         prefill_chunk=8, prefix_cache=True,
         max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+        ops_port=plane.port,
     )
     system = rng.integers(0, cfg.vocab_size, size=16).astype(np.int32)
     preqs = []
@@ -256,12 +378,15 @@ def main() -> int:
             h.cancel()
         preqs.append((prompt, mnt, 2000 + i, h))
 
-    for _ in range(MAX_STEPS):
+    for tick in range(MAX_STEPS):
         if not (len(engp.scheduler) or engp._n_running()):
             break
         engp.step()
+        if tick % 25 == 10:
+            scrape_check(engp)
     else:
         return fail(f"prefix soak did not drain within {MAX_STEPS} steps")
+    scrape_check(engp)
 
     n_ok = n_typed = 0
     for prompt, mnt, key, h in preqs:
@@ -334,6 +459,7 @@ def main() -> int:
         scheduler="qos",
         tenant_weights={"gold": 8.0, "silver": 2.0, "bronze": 1.0},
         max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+        ops_port=plane.port,
     )
     tenants = [("gold", 2), ("silver", 1), ("bronze", 0)]
     qreqs = []
@@ -367,12 +493,19 @@ def main() -> int:
             h.cancel()
         qreqs.append((prompt, mnt, 3100 + i, h))
 
-    for _ in range(MAX_STEPS):
+    for tick in range(MAX_STEPS):
         if not (len(engq.scheduler) or engq._n_running()):
             break
         engq.step()
+        if tick % 25 == 10:
+            scrape_check(engq)
     else:
         return fail(f"QoS soak did not drain within {MAX_STEPS} steps")
+    qsamples = scrape_check(engq)
+    # The per-tenant queue-depth family must be PRUNED at drain: free-
+    # form tenant ids leave /metrics when their queues empty.
+    if pick(qsamples, "serve_queue_depth", tenant="bronze") is not None:
+        return fail("idle tenant gauge survived in /metrics (prune broken)")
 
     n_ok = n_typed = 0
     for prompt, mnt, key, h in qreqs:
@@ -412,6 +545,103 @@ def main() -> int:
         f"chaos_soak: QoS soak OK — {n_ok} token-identical, {n_typed} "
         f"typed failures, preempt_swap={qst['preemptions_swap']}, "
         f"preempt_replay={qst['preemptions_replay']}"
+    )
+
+    # ---------------- Phase 1.7: deliberate tick-loop wedge ----------------
+    # The failure mode none of the soaks above can catch: the tick loop
+    # silently stops while work is pending — nothing raises, nothing
+    # fails typed.  A dedicated engine on its own tight-deadline plane:
+    # the stall watchdog must detect the wedge within its deadline,
+    # flight-dump reason=stall, set serve.stalled, and mark the engine
+    # OVERLOADED (visible as a 503 /healthz); resuming ticks must clear
+    # the latch and finish the stream token-identical.
+    import urllib.error
+    import urllib.request
+
+    faults.reset("")
+    # No EOS on the wedge engine: an early EOS inside the first decode
+    # chunk would finish the request in one tick, leaving nothing
+    # pending — and stillness without pending work is (correctly) not a
+    # stall.  The 24-token budget guarantees in-flight work to wedge.
+    engw = Engine(
+        params, model=llama, cfg=cfg, num_slots=4,
+        block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+        drain_deadline_s=120.0, ops_port=0,
+        ops_config=tdx_ops.OpsConfig(
+            stall_deadline_s=0.5, watchdog_poll_s=0.05
+        ),
+    )
+    wurl = engw._ops_plane.server.url
+    # Warm the compiled programs first: a compile pause is a real stall
+    # to the watchdog, and this phase wants exactly one, deliberate one.
+    hw = engw.submit(
+        np.arange(1, 5, dtype=np.int32), max_new_tokens=4, key=7000
+    )
+    while not hw.done:
+        engw.step()
+    stalls_before = telemetry.counter("serve.stalls").value
+    wedge_prompt = rng.integers(0, cfg.vocab_size, size=8).astype(np.int32)
+    hw = engw.submit(wedge_prompt, max_new_tokens=24, key=7001)
+    engw.step()  # prefill + first decode chunk — then the driver wedges
+    if hw.done or not engw._n_running():
+        return fail("wedge setup left no in-flight work to stall on")
+    t0 = time.monotonic()
+    while engw.health() is not Health.OVERLOADED:
+        if time.monotonic() - t0 > 10.0:
+            wd = next(
+                (w for _, w in engw._ops_plane._engines.values()), None
+            )
+            return fail(
+                "watchdog did not detect the wedge within 10 s "
+                f"(health={engw.health()}, running={engw._n_running()}, "
+                f"queued={len(engw.scheduler)}, "
+                f"stalls={getattr(wd, 'stalls', None)}, "
+                f"wd_alive={wd.is_alive() if wd else None})"
+            )
+        time.sleep(0.05)
+    detect_s = time.monotonic() - t0
+    wsamples = scrape(wurl)
+    if pick(wsamples, "serve_stalled", engine=engw.engine_id) != 1:
+        return fail("serve.stalled gauge not set on the wedged engine")
+    if telemetry.counter("serve.stalls").value <= stalls_before:
+        return fail("serve.stalls counter not bumped by the wedge")
+    try:
+        urllib.request.urlopen(wurl + "/healthz", timeout=10)
+        return fail("/healthz returned 200 for a wedged sole engine")
+    except urllib.error.HTTPError as e:
+        if e.code != 503:
+            return fail(f"/healthz returned {e.code}, wanted 503")
+    # Un-wedge: the engine's own ticks clear the latch and restore READY.
+    while not hw.done:
+        engw.step()
+    expect = [
+        int(t) for t in np.asarray(
+            generate(
+                params, wedge_prompt[None], jax.random.PRNGKey(7001),
+                model=llama, cfg=cfg, max_new_tokens=24,
+            )
+        )[0]
+    ]
+    if hw.result() != expect:
+        return fail("wedged stream lost token identity after resume")
+    engw.step()
+    if engw.health() is not Health.READY:
+        return fail(f"health {engw.health()} != READY after un-wedge")
+    eid_w = engw.engine_id
+    t0 = time.monotonic()  # latch clears on the watchdog's next poll
+    while telemetry.gauges().get(f"serve.stalled{{engine={eid_w}}}") != 0:
+        if time.monotonic() - t0 > 5.0:
+            return fail("stall latch did not clear after progress resumed")
+        time.sleep(0.05)
+    engw.close()
+    try:
+        scrape(wurl)
+        return fail("wedge plane still listening after Engine.close()")
+    except OSError:
+        pass  # connection refused: the listener is gone
+    print(
+        f"chaos_soak: wedge OK — detected in {detect_s:.2f}s "
+        "(deadline 0.5s), stream resumed token-identical, plane torn down"
     )
 
     # ---------------- Phase 2: SIGTERM drain under load ----------------
@@ -459,7 +689,30 @@ def main() -> int:
 
     # ---------------- Trace assertions ----------------
     telemetry.emit_counters()
-    spans, counters = parse_trace(trace)
+    plane.release()
+    spans, counters, dumps = parse_trace(trace)
+    if not attr_seen["goodput"]:
+        return fail(
+            "no mid-soak /metrics scrape observed occupancy > 0 with "
+            "goodput > 0 — attribution never caught the engine decoding"
+        )
+    if counters.get("serve.stalls", 0) < 1:
+        return fail("trace counters show no serve.stalls from the wedge")
+    if os.environ.get("TDX_FLIGHT_RECORDER"):
+        if "stall" not in dumps:
+            return fail(
+                f"trace shows no reason=stall flight dump (dumps: {dumps})"
+            )
+    else:
+        print(
+            "chaos_soak: note — TDX_FLIGHT_RECORDER off, stall-dump "
+            "trace assertion skipped"
+        )
+    print(
+        f"chaos_soak: ops OK — {attr_seen['scrapes']} validated /metrics "
+        f"scrapes, stalls={counters.get('serve.stalls')}, "
+        f"scrape_count={counters.get('ops.scrapes')}"
+    )
     missing = {"serve.recover", "serve.drain", "serve.prefill", "serve.step"} - spans
     if missing:
         return fail(f"trace missing spans {missing}")
@@ -512,9 +765,11 @@ def fleet_main() -> int:
     from torchdistx_tpu.serving import (
         DeadlineExceeded,
         Engine,
+        Health,
         RequestCancelled,
         RequestError,
     )
+    from torchdistx_tpu.telemetry import ops as tdx_ops
 
     cfg = llama.llama_test()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -554,7 +809,15 @@ def fleet_main() -> int:
         error string or None."""
         eng_a = make_engine(temperature, top_k)
         eng_b = make_engine(temperature, top_k)
-        router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+        # Ops plane over the whole fleet; the traffic phases scrape it
+        # mid-soak at the kill and swap points (watchdog off here: the
+        # handles drive the engines pull-by-pull, so long idle gaps are
+        # normal — the dedicated wedge segment below tests detection).
+        router = FleetRouter(
+            [eng_a, eng_b], version="v1", max_hops=4,
+            ops_port=0, ops_config=tdx_ops.OpsConfig(watchdog=False),
+        )
+        ops_url = router.ops_plane.server.url
         reqs = []
         for i in range(n):
             plen = int(rng.integers(3, 14))
@@ -580,12 +843,16 @@ def fleet_main() -> int:
                     leaf.delete()
                 eng_a.close()
                 router.poll()
+                # Mid-churn scrape: still valid exposition, and the
+                # dead replica's /healthz entry is gone.
+                scrape(ops_url)
             if idx == (3 * n) // 4:
                 # Upgrade under the remaining load.  Same weights (an
                 # operational upgrade drill): every stream still checks
                 # against one solo oracle, whichever version served it.
                 eng_c["eng"] = make_engine(temperature, top_k)
                 hot_swap(router, lambda: eng_c["eng"], version="v2")
+                scrape(ops_url)
             try:
                 toks = h.result()
             except RequestError:
@@ -627,6 +894,13 @@ def fleet_main() -> int:
         versions = [r.version for r in router.replicas()]
         if versions != ["v2"]:
             return f"[{label}] fleet did not converge on v2: {versions}"
+        scrape(ops_url)  # final validated scrape for this phase
+        router.close()
+        try:
+            scrape(ops_url)
+            return f"[{label}] ops plane still up after router.close()"
+        except OSError:
+            pass  # connection refused: listener torn down with the fleet
         print(
             f"chaos_soak: fleet {label} OK — {n_ok} token-identical, "
             f"{n_typed} typed deadline/cancel failures "
@@ -644,9 +918,87 @@ def fleet_main() -> int:
     if telemetry.counter("fleet.failovers").value < 1:
         return fail("fleet soak produced no failovers")
 
+    # ---------------- Wedge detection + route-around ----------------
+    # A replica whose tick loop silently stops (queued work, no
+    # progress) must be detected by the plane's watchdog, marked
+    # OVERLOADED, and ROUTED AROUND — then rejoin once it recovers.
+    def make_wedge_engine():
+        # No EOS: an early EOS could finish the wedge stream in one
+        # tick, leaving nothing pending to stall on.
+        return Engine(
+            params, model=llama, cfg=cfg, num_slots=4, block_size=8,
+            num_blocks=33, max_model_len=64, decode_chunk=4,
+            drain_deadline_s=120.0, handle_preemption=False,
+        )
+
+    eng_a = make_wedge_engine()
+    eng_b = make_wedge_engine()
+    router = FleetRouter(
+        [eng_a, eng_b], version="v1",
+        ops_port=0, ops_config=tdx_ops.OpsConfig(
+            stall_deadline_s=0.5, watchdog_poll_s=0.05
+        ),
+    )
+    ops_url = router.ops_plane.server.url
+    for eng, key in ((eng_a, 20_000), (eng_b, 20_001)):  # warm compiles
+        h = eng.submit(
+            np.arange(1, 5, dtype=np.int32), max_new_tokens=4, key=key
+        )
+        while not h.done:
+            eng.step()
+    wprompt = rng.integers(0, cfg.vocab_size, size=8).astype(np.int32)
+    hb = eng_b.submit(wprompt, max_new_tokens=24, key=20_002)
+    eng_b.step()  # in-flight work on B — then B's driver wedges
+    if hb.done or not eng_b._n_running():
+        return fail("fleet wedge setup left no in-flight work to stall on")
+    t0 = time.monotonic()
+    while eng_b.health() is not Health.OVERLOADED:
+        if time.monotonic() - t0 > 10.0:
+            return fail("fleet watchdog did not detect the wedge in 10 s")
+        time.sleep(0.05)
+    detect_s = time.monotonic() - t0
+    samples = scrape(ops_url)
+    if pick(samples, "serve_stalled", engine=eng_b.engine_id) != 1:
+        return fail("serve.stalled not set on the wedged replica")
+    for _ in range(4):
+        rep = router._pick()
+        if rep is None or rep.engine is not eng_a:
+            return fail("router still routing to the wedged replica")
+    # Recovery: B's driver resumes, the stream finishes token-identical,
+    # and the replica becomes routable again.
+    while not hb.done:
+        eng_b.step()
+    expect = [
+        int(t) for t in np.asarray(
+            generate(
+                params, wprompt[None], jax.random.PRNGKey(20_002),
+                model=llama, cfg=cfg, max_new_tokens=24,
+            )
+        )[0]
+    ]
+    if hb.result() != expect:
+        return fail("wedged replica's stream lost token identity")
+    eng_b.step()
+    if eng_b.health() is not Health.READY:
+        return fail(f"wedged replica stuck {eng_b.health()} after resume")
+    router.close()
+    try:
+        scrape(ops_url)
+        return fail("fleet ops plane still up after router.close()")
+    except OSError:
+        pass
+    print(
+        f"chaos_soak: fleet wedge OK — detected in {detect_s:.2f}s, "
+        "router avoided the replica, rejoined after recovery"
+    )
+
     # ---------------- Trace assertions ----------------
     telemetry.emit_counters()
-    spans, counters = parse_trace(trace)
+    spans, counters, dumps = parse_trace(trace)
+    if counters.get("serve.stalls", 0) < 1:
+        return fail("trace shows no serve.stalls from the fleet wedge")
+    if os.environ.get("TDX_FLIGHT_RECORDER") and "stall" not in dumps:
+        return fail(f"trace shows no reason=stall dump (dumps: {dumps})")
     missing = {"fleet.swap", "serve.drain", "serve.prefill"} - spans
     if missing:
         return fail(f"trace missing spans {missing}")

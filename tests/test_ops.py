@@ -1,0 +1,746 @@
+"""Live ops plane (ISSUE 10): metrics exposition, healthz/requests
+endpoints, per-tick utilization attribution, SLO burn-rate monitor,
+stall watchdog, and bounded labeled-metric cardinality.
+
+The rendering tests double as the exposition-format contract: the
+parser here mirrors the one scripts/chaos_soak.py validates scrapes
+with, so a drift in the renderer fails both."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.serving import Engine, Health
+from torchdistx_tpu.serving.blocks import BlockAllocator
+from torchdistx_tpu.serving.qos import QoSScheduler
+from torchdistx_tpu.serving.scheduler import Request
+from torchdistx_tpu.telemetry import _core, ops
+
+ENGINE_KW = dict(
+    num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
+    handle_preemption=False,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    prev = telemetry.configure(collect=False, jsonl=None, flight=None)
+    telemetry.reset()
+    ops.enable_tick_attribution(False)
+    yield
+    # A plane leaked by a failing test must not hold its port (or its
+    # watchdog threads) into the next.
+    for plane in list(ops._PLANES.values()):
+        plane.close()
+    ops.enable_tick_attribution(False)
+    telemetry.configure(**prev)
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def http_get(url, timeout=5.0):
+    """(status, body-bytes) — non-2xx returned, not raised."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def parse_exposition(text):
+    """Validating Prometheus text-exposition parser (the contract the
+    chaos-soak scrape check enforces too).  Returns
+    ``{family: {"type": t, "samples": [(name, labels, value)]}}`` and
+    asserts histogram coherence: cumulative buckets non-decreasing and
+    ``+Inf`` == ``_count``."""
+    fams, cur = {}, None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[:2] == ["#", "TYPE"], f"bad comment line: {line!r}"
+            assert len(parts) == 4, f"bad TYPE line: {line!r}"
+            cur = parts[2]
+            assert cur not in fams, f"duplicate TYPE for {cur}"
+            fams[cur] = {"type": parts[3], "samples": []}
+            continue
+        name, _, rest = line.partition("{")
+        labels = {}
+        if rest:
+            lblstr, _, rest = rest.rpartition("}")
+            for m in __import__("re").finditer(
+                r'([a-zA-Z0-9_:]+)="((?:[^"\\]|\\.)*)"', lblstr
+            ):
+                labels[m.group(1)] = (
+                    m.group(2)
+                    .replace("\\n", "\n")
+                    .replace('\\"', '"')
+                    .replace("\\\\", "\\")
+                )
+            value = rest.strip()
+        else:
+            name, _, value = line.partition(" ")
+            value = value.strip()
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and fam[: -len(suffix)] in fams:
+                fam = name[: -len(suffix)]
+        assert fam in fams, f"sample before TYPE: {line!r}"
+        fams[fam]["samples"].append((name, labels, float(value)))
+    for fam, d in fams.items():
+        if d["type"] != "histogram":
+            continue
+        series = {}
+        for name, labels, value in d["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            s = series.setdefault(key, {"buckets": [], "count": None})
+            if name.endswith("_bucket"):
+                s["buckets"].append((labels["le"], value))
+            elif name.endswith("_count"):
+                s["count"] = value
+        for key, s in series.items():
+            counts = [v for _, v in s["buckets"]]
+            assert counts == sorted(counts), f"{fam}{key}: buckets not cumulative"
+            infs = [v for le, v in s["buckets"] if le == "+Inf"]
+            assert infs and infs[0] == s["count"], (
+                f"{fam}{key}: +Inf bucket {infs} != count {s['count']}"
+            )
+    return fams
+
+
+def sample(fams, name, **labels):
+    base = name
+    for famname, d in fams.items():
+        for sname, slabels, value in d["samples"]:
+            if sname == base and all(
+                slabels.get(k) == str(v) for k, v in labels.items()
+            ):
+                return value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering
+
+
+def test_prom_counters_gauges_render():
+    telemetry.counter("ops_test.hits").add(7)
+    telemetry.gauge("ops_test.depth").set(3.5)
+    fams = parse_exposition(ops.render_prometheus())
+    assert fams["ops_test_hits"]["type"] == "counter"
+    assert sample(fams, "ops_test_hits") == 7
+    assert fams["ops_test_depth"]["type"] == "gauge"
+    assert sample(fams, "ops_test_depth") == 3.5
+
+
+def test_prom_labeled_names_become_labels():
+    telemetry.gauge("ops_test.qd", tenant="alice").set(2)
+    telemetry.gauge("ops_test.qd", tenant="bob").set(5)
+    fams = parse_exposition(ops.render_prometheus())
+    # One TYPE line for the family, one sample per label set.
+    assert len(fams["ops_test_qd"]["samples"]) == 2
+    assert sample(fams, "ops_test_qd", tenant="alice") == 2
+    assert sample(fams, "ops_test_qd", tenant="bob") == 5
+
+
+def test_prom_state_gauge_for_non_numeric_values():
+    telemetry.gauge("ops_test.health", engine="e0").set("ready")
+    fams = parse_exposition(ops.render_prometheus())
+    name, labels, value = [
+        s for s in fams["ops_test_health"]["samples"]
+        if s[1].get("engine") == "e0"
+    ][0]
+    assert labels["state"] == "ready" and value == 1
+
+
+def test_prom_histogram_inf_bucket_and_sum():
+    h = telemetry.histogram("ops_test.lat")
+    for v in (1e-5, 0.003, 0.05, 2.0, 1e6):  # under- and overflow too
+        h.observe(v)
+    fams = parse_exposition(ops.render_prometheus())  # asserts +Inf == count
+    assert sample(fams, "ops_test_lat_count") == 5
+    assert abs(sample(fams, "ops_test_lat_sum") - (1e-5 + 0.003 + 0.05 + 2.0 + 1e6)) < 1e-6
+
+
+def test_prom_label_escaping():
+    telemetry.gauge("ops_test.esc", tenant='a"b\\c').set(1)
+    text = ops.render_prometheus()
+    assert 'tenant="a\\"b\\\\c"' in text
+    fams = parse_exposition(text)
+    assert sample(fams, "ops_test_esc", **{"tenant": 'a"b\\c'}) == 1
+
+
+def test_prom_free_form_label_value_roundtrip():
+    """Label values are request-supplied (tenant ids): structural
+    characters (',', '=', '{', '}') must survive the canonical-name
+    round trip instead of splitting into phantom labels."""
+    nasty = "a,b=c{d}%e"
+    telemetry.gauge("ops_test.ff", tenant=nasty).set(3)
+    fams = parse_exposition(ops.render_prometheus())
+    assert sample(fams, "ops_test_ff", tenant=nasty) == 3
+    assert len(fams["ops_test_ff"]["samples"]) == 1
+    assert telemetry.remove("ops_test.ff", tenant=nasty)
+
+
+def test_prom_metric_name_sanitized():
+    telemetry.counter("serve.prefix-hits.v2").add(1)
+    fams = parse_exposition(ops.render_prometheus())
+    assert sample(fams, "serve_prefix_hits_v2") == 1
+
+
+def test_prom_counter_across_reset():
+    """reset() zeroes counters IN PLACE: the same instrument re-renders
+    from 0 (a scraper sees an ordinary counter reset), with no stale
+    duplicate series left behind."""
+    c = telemetry.counter("ops_test.mono")
+    c.add(5)
+    assert sample(parse_exposition(ops.render_prometheus()), "ops_test_mono") == 5
+    telemetry.reset()
+    assert sample(parse_exposition(ops.render_prometheus()), "ops_test_mono") == 0
+    c.add(2)  # the pre-reset binding still feeds the registered object
+    fams = parse_exposition(ops.render_prometheus())
+    assert sample(fams, "ops_test_mono") == 2
+    assert len(fams["ops_test_mono"]["samples"]) == 1
+
+
+def test_prom_concurrent_scrape_not_torn():
+    """/metrics under concurrent observe/add: every scrape parses and
+    every histogram snapshot is internally coherent (+Inf == count)."""
+    h = telemetry.histogram("ops_test.torn")
+    c = telemetry.counter("ops_test.torn_hits")
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            h.observe(10.0 ** ((i % 13) - 6))
+            c.add()
+            i += 1
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            parse_exposition(ops.render_prometheus())
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    fams = parse_exposition(ops.render_prometheus())
+    assert sample(fams, "ops_test_torn_count") > 0
+
+
+# ---------------------------------------------------------------------------
+# Bounded labeled-metric cardinality (telemetry.remove + QoS prune)
+
+
+def test_remove_drops_instruments():
+    telemetry.counter("ops_test.rm").add(1)
+    telemetry.gauge("ops_test.rm_g", tenant="t0").set(1)
+    telemetry.histogram("ops_test.rm_h").observe(1.0)
+    assert telemetry.remove("ops_test.rm")
+    assert telemetry.remove("ops_test.rm_g", tenant="t0")
+    assert telemetry.remove("ops_test.rm_h")
+    assert not telemetry.remove("ops_test.rm")  # already gone
+    text = ops.render_prometheus()
+    assert "ops_test_rm" not in text
+
+
+def _churn_tenants(n, active=8):
+    """Push/pop n requests with distinct tenant ids through a
+    QoSScheduler, keeping ~``active`` waiting at any moment."""
+    alloc = BlockAllocator(64, 8)
+    sched = QoSScheduler(4)
+    for i in range(n):
+        sched.push(
+            Request(
+                rid=i, prompt=np.zeros(4, np.int32), max_new_tokens=1,
+                key=np.zeros(2, np.uint32), handle=None, tenant=f"tenant{i}",
+            )
+        )
+        if i >= active:
+            sched.pop_admissible(1, alloc, 8)
+    sched.flush()
+    return sched
+
+
+def test_tenant_gauges_pruned_on_idle():
+    """Distinct per-tenant ids must not grow the registry: the
+    queue-depth gauge family tracks ACTIVE tenants (waiting work), and a
+    tenant popping to idle leaves the registry entirely."""
+    base = len(_core._state.gauges)
+    _churn_tenants(25_000)
+    growth = len(_core._state.gauges) - base
+    assert growth <= 1, f"registry grew by {growth} gauges"
+    assert "tenant24999" not in ops.render_prometheus()
+
+
+@pytest.mark.slow
+def test_million_tenants_bounded():
+    base = len(_core._state.gauges)
+    _churn_tenants(1_000_000)
+    assert len(_core._state.gauges) - base <= 1
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor (synthetic event stream, deterministic timestamps)
+
+
+def _req_event(name, rid, ts, **attrs):
+    return {"type": "event", "name": name, "rid": rid, "ts": ts,
+            "attrs": attrs}
+
+
+def _feed_terminal(mon, rid, ts, tenant="acme", ok=True):
+    mon._on_record(_req_event("req.submitted", rid, ts, tenant=tenant))
+    if ok:
+        mon._on_record(_req_event("req.finished", rid, ts + 0.01))
+    else:
+        mon._on_record(
+            _req_event("req.failed", rid, ts + 0.01,
+                       error="DeadlineExceeded", retryable=False)
+        )
+
+
+def test_slo_burn_fires_and_recovers():
+    fired = []
+    cfg = ops.SLOConfig(
+        slo=0.9, fast_window_s=10, slow_window_s=50, burn_threshold=2.0,
+        min_samples=5, on_burn=lambda tenant, info: fired.append((tenant, info)),
+    )
+    mon = ops.SLOMonitor(cfg)
+    t0 = 1000.0
+    for i in range(8):  # all misses: burn = (1.0 / 0.1) = 10 >> 2
+        _feed_terminal(mon, i, t0 + i * 0.1, ok=False)
+    assert mon.burning() == {"acme": True}
+    assert fired and fired[0][0] == "acme"
+    assert fired[0][1]["burn_fast"] >= 2.0
+    assert telemetry.gauges()["serve.slo_burning{tenant=acme}"] == 1
+    # Recovery: the bad window ages out of BOTH windows.
+    for i in range(20):
+        _feed_terminal(mon, 100 + i, t0 + 60 + i * 0.1, ok=True)
+    assert mon.burning() == {"acme": False}
+    assert telemetry.gauges()["serve.slo_burning{tenant=acme}"] == 0
+    assert len(fired) == 1  # recovery does not re-fire
+    assert mon.summary()["acme"]["fast"]["deadline_hit_rate"] == 1.0
+
+
+def test_slo_single_blip_does_not_fire():
+    """The multi-window rule: a fast-window spike alone (slow window
+    still healthy) must not alert."""
+    mon = ops.SLOMonitor(ops.SLOConfig(
+        slo=0.9, fast_window_s=10, slow_window_s=1000, burn_threshold=2.0,
+        min_samples=5,
+    ))
+    t0 = 1000.0
+    for i in range(200):  # long healthy history fills the slow window
+        _feed_terminal(mon, i, t0 + i, ok=True)
+    for i in range(6):  # then a fast-window blip
+        _feed_terminal(mon, 1000 + i, t0 + 200 + i * 0.1, ok=False)
+    # Never burned: no state transition recorded, no gauge minted.
+    assert not mon.burning().get("acme", False)
+    assert "serve.slo_burning{tenant=acme}" not in telemetry.gauges()
+
+
+def test_slo_ttft_target_trigger():
+    mon = ops.SLOMonitor(ops.SLOConfig(
+        slo=0.5, ttft_target_s=0.2, fast_window_s=10, slow_window_s=50,
+        burn_threshold=1e9, min_samples=5,  # burn path unreachable
+    ))
+    t0 = 1000.0
+    for i in range(8):
+        mon._on_record(_req_event("req.submitted", i, t0 + i * 0.1,
+                                  tenant="acme"))
+        mon._on_record(_req_event("req.first_token", i, t0 + i * 0.1,
+                                  ttft_s=0.9))
+    assert mon.burning() == {"acme": True}
+
+
+def test_slo_idle_tenant_pruned_from_registry():
+    mon = ops.SLOMonitor(ops.SLOConfig(
+        slo=0.9, fast_window_s=10, slow_window_s=50, burn_threshold=2.0,
+        min_samples=5,
+    ))
+    t0 = 1000.0
+    for i in range(8):
+        _feed_terminal(mon, i, t0 + i * 0.1, tenant="ghost", ok=False)
+    assert "serve.slo_burning{tenant=ghost}" in telemetry.gauges()
+    # Far-future activity from another tenant ages ghost out entirely.
+    for i in range(ops.SLOMonitor._PRUNE_EVERY):
+        _feed_terminal(mon, 1000 + i, t0 + 10_000 + i, tenant="live")
+    assert "ghost" not in mon.burning()
+    assert "serve.slo_burning{tenant=ghost}" not in telemetry.gauges()
+
+
+def test_slo_on_burn_may_reenter_monitor():
+    """The on_burn callback runs OUTSIDE the monitor's lock: a callback
+    reading the monitor's own public API (the natural thing to log)
+    must not deadlock the emitting thread."""
+    seen = []
+    box = {}
+
+    def cb(tenant, info):
+        seen.append((tenant, box["mon"].burning(), box["mon"].summary()))
+
+    mon = ops.SLOMonitor(ops.SLOConfig(
+        slo=0.9, fast_window_s=10, slow_window_s=50, burn_threshold=2.0,
+        min_samples=5, on_burn=cb,
+    ))
+    box["mon"] = mon
+    for i in range(8):
+        _feed_terminal(mon, i, 1000.0 + i * 0.1, ok=False)
+    assert seen and seen[0][0] == "acme"
+    assert seen[0][1] == {"acme": True}
+    assert seen[0][2]["acme"]["burning"] is True
+
+
+def test_slo_monitor_as_listener():
+    """Subscribed, the monitor is a recording target: req.* events are
+    built for it even with every sink off — and close() unsubscribes,
+    restoring the disabled path."""
+    assert not telemetry.events_enabled()
+    mon = ops.SLOMonitor(ops.SLOConfig(min_samples=1)).subscribe()
+    try:
+        assert telemetry.events_enabled()
+        telemetry.event("req.submitted", rid="r1", tenant="t")
+        telemetry.event("req.finished", rid="r1")
+        assert mon.summary()["t"]["fast"]["n"] == 1
+    finally:
+        mon.close()
+    assert not telemetry.events_enabled()
+    assert "serve.slo_burning{tenant=t}" not in telemetry.gauges()
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog
+
+
+class _FakeEngine:
+    def __init__(self, eid="fake0"):
+        self.engine_id = eid
+        self._tick_no = 0
+        self._decode_tokens = 0
+        self._prefill_no = 0
+        self.scheduler = [1]  # one queued request
+        self.stalled = 0
+
+    def health(self):
+        return Health.READY
+
+    def _n_running(self):
+        return 0
+
+    def _mark_stalled(self):
+        self.stalled += 1
+
+
+def _wait_for(pred, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_watchdog_fires_on_wedge_then_clears():
+    telemetry.configure(collect=True, flight=True)
+    eng = _FakeEngine()
+    telemetry.event("req.queued", rid="r0")  # something in the ring to dump
+    wd = ops.StallWatchdog(eng, deadline_s=0.08, poll_s=0.01)
+    wd.start()
+    try:
+        assert _wait_for(lambda: wd.stalls == 1)
+        assert eng.stalled == 1
+        assert telemetry.gauges()["serve.stalled{engine=fake0}"] == 1
+        recs = telemetry.snapshot()["spans"]
+        dumps = [r for r in recs if r.get("type") == "flight_dump"]
+        assert dumps and dumps[0]["reason"] == "stall"
+        assert any(r.get("name") == "ops.stall" for r in recs)
+        # Progress clears the latch without a second fire.
+        eng._tick_no += 1
+        assert _wait_for(
+            lambda: telemetry.gauges()["serve.stalled{engine=fake0}"] == 0
+        )
+        assert wd.stalls == 1
+    finally:
+        wd.stop()
+    # The stopped watchdog's gauge leaves the registry (replica churn
+    # must not accrete one serve.stalled series per engine ever seen).
+    assert "serve.stalled{engine=fake0}" not in telemetry.gauges()
+
+
+def test_watchdog_quiet_when_idle_or_progressing():
+    eng = _FakeEngine("fake1")
+    eng.scheduler = []  # idle: nothing pending, stillness is fine
+    wd = ops.StallWatchdog(eng, deadline_s=0.05, poll_s=0.01)
+    wd.start()
+    try:
+        time.sleep(0.2)
+        assert wd.stalls == 0
+        eng.scheduler = [1]  # pending, but now the engine ticks
+        for _ in range(20):
+            eng._tick_no += 1
+            time.sleep(0.01)
+        assert wd.stalls == 0
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# The ops endpoint on a live engine
+
+
+def test_engine_ops_endpoints(family):
+    model, cfg, params = family
+    telemetry.configure(collect=True, flight=True)
+    eng = Engine(
+        params, model=model, cfg=cfg,
+        ops_port=0, ops_config=ops.OpsConfig(watchdog=False),
+        **ENGINE_KW,
+    )
+    url = eng._ops_plane.server.url
+    try:
+        code, body = http_get(url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        h = eng.submit(prompt_of(4), max_new_tokens=12, key=0)
+        eng.step()  # prefill (+ first decode chunk)
+        eng.step()  # decoding, well short of the 12-token budget
+        code, body = http_get(url + "/metrics")
+        assert code == 200
+        fams = parse_exposition(body.decode())
+        eid = eng.engine_id
+        assert sample(fams, "serve_occupancy", engine=eid) is not None
+        assert 0 < sample(fams, "serve_occupancy", engine=eid) <= 1
+        assert 0 < sample(fams, "serve_page_util", engine=eid) <= 1
+        assert sample(fams, "serve_goodput", engine=eid) > 0  # decoding now
+        assert sample(fams, "serve_tick_s_count", engine=eid) == 2
+        assert sample(fams, "ops_scrapes") >= 1
+        code, body = http_get(url + "/requests")
+        assert code == 200
+        reqs = json.loads(body)["requests"]
+        assert any(r["rid"].endswith("-r0") for r in reqs)
+        assert h.result()  # finish cleanly
+        code, body = http_get(url + "/404")
+        assert code == 404
+    finally:
+        eng.close()
+    # STOPPED tore the plane down: the port refuses (the strongest
+    # non-200 /healthz), and no listener/watchdog threads linger.
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url + "/healthz", timeout=2.0)
+    assert not any(
+        t.name.startswith(("tdx-ops", "tdx-stall")) and t.is_alive()
+        for t in threading.enumerate()
+    )
+
+
+def test_engine_wedge_detected_marked_overloaded(family):
+    """The acceptance wedge, in-process: queued work + a tick loop that
+    stopped being driven → the watchdog flight-dumps reason=stall and
+    marks the engine OVERLOADED; resuming ticks restores READY."""
+    model, cfg, params = family
+    telemetry.configure(collect=True, flight=True)
+    eng = Engine(
+        params, model=model, cfg=cfg, ops_port=0,
+        ops_config=ops.OpsConfig(stall_deadline_s=0.15, watchdog_poll_s=0.02),
+        **ENGINE_KW,
+    )
+    try:
+        # A budget one tick cannot finish: the wedge leaves the slot
+        # occupied (pending work), which is what a stall requires.
+        h = eng.submit(prompt_of(4), max_new_tokens=32, key=0)
+        eng.step()  # prefill + first decode chunk, then the driver wedges
+        assert _wait_for(lambda: eng.health() is Health.OVERLOADED)
+        dumps = [
+            r for r in telemetry.snapshot()["spans"]
+            if r.get("type") == "flight_dump"
+        ]
+        assert dumps and dumps[-1]["reason"] == "stall"
+        # >= 1: a compile-slow first tick can trip the (deliberately
+        # tight) deadline once before the real wedge does.
+        assert telemetry.counters()["serve.stalls"] >= 1
+        while not h.done:
+            eng.step()
+        assert h.result()
+        assert eng.health() is Health.READY  # its own tick re-checked
+    finally:
+        eng.close()
+
+
+def test_env_ops_port(family, monkeypatch):
+    model, cfg, params = family
+    monkeypatch.setenv("TDX_OPS_PORT", "0")
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    try:
+        assert eng._ops_plane is not None
+        code, _ = http_get(eng._ops_plane.server.url + "/healthz")
+        assert code == 200
+    finally:
+        eng.close()
+    monkeypatch.delenv("TDX_OPS_PORT")
+    eng2 = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    try:
+        assert eng2._ops_plane is None
+    finally:
+        eng2.close()
+
+
+def test_shared_plane_two_engines(family):
+    """Two engines on one port share a plane; /healthz stays 200 (and
+    keeps serving) until the LAST engine stops."""
+    model, cfg, params = family
+    eng1 = Engine(params, model=model, cfg=cfg, ops_port=0,
+                  ops_config=ops.OpsConfig(watchdog=False), **ENGINE_KW)
+    port = eng1._ops_plane.port
+    eng2 = Engine(params, model=model, cfg=cfg, ops_port=port, **ENGINE_KW)
+    url = eng1._ops_plane.server.url
+    assert eng2._ops_plane is eng1._ops_plane
+    code, body = http_get(url + "/healthz")
+    assert code == 200 and len(json.loads(body)["engines"]) == 2
+    eng1.close()
+    code, body = http_get(url + "/healthz")
+    payload = json.loads(body)
+    assert code == 200 and len(payload["engines"]) == 1
+    assert eng1.engine_id not in payload["engines"]
+    eng2.close()
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url + "/healthz", timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# Disabled path: no ops plane → zero per-tick overhead
+
+
+def test_disabled_path_no_tick_work(family, monkeypatch):
+    """Without ops_port/TDX_OPS_PORT (and attribution off), a served
+    request never calls the attribution path and mints no per-tick
+    instruments — record-bomb style."""
+    model, cfg, params = family
+
+    def bomb(self, *a, **k):  # pragma: no cover — the point is it never runs
+        raise AssertionError("_tick_telemetry ran with the ops plane off")
+
+    monkeypatch.setattr(Engine, "_tick_telemetry", bomb)
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    try:
+        assert eng._ops_plane is None
+        h = eng.submit(prompt_of(4), max_new_tokens=4, key=0)
+        assert h.result()
+    finally:
+        eng.close()
+    assert eng._g_occupancy is None
+    eid = eng.engine_id
+    gauges = telemetry.gauges()
+    for g in ("serve.occupancy", "serve.page_util", "serve.goodput",
+              "serve.prefill_budget", "serve.churn"):
+        assert f"{g}{{engine={eid}}}" not in gauges
+    assert f"serve.tick_s{{engine={eid}}}" not in telemetry.histograms()
+
+
+def test_tick_attribution_without_server(family):
+    """bench's path: enable_tick_attribution() turns the gauges on with
+    no HTTP listener."""
+    model, cfg, params = family
+    prev = ops.enable_tick_attribution(True)
+    try:
+        eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        h = eng.submit(prompt_of(4), max_new_tokens=12, key=0)
+        eid = eng.engine_id
+        occ, goodput, ticks = [], [], 0
+        while not h.done:
+            eng.step()
+            ticks += 1
+            gauges = telemetry.gauges()
+            occ.append(gauges[f"serve.occupancy{{engine={eid}}}"])
+            goodput.append(gauges[f"serve.goodput{{engine={eid}}}"])
+        assert 0 < max(occ) <= 1
+        assert max(goodput) > 0  # > 0 on every decoding tick
+        assert (
+            telemetry.histograms()[f"serve.tick_s{{engine={eid}}}"]["count"]
+            == ticks
+        )
+        assert h.result()
+        eng.close()
+    finally:
+        ops.enable_tick_attribution(prev)
+
+
+# ---------------------------------------------------------------------------
+# Fleet wiring
+
+
+def test_fleet_router_ops_plane(family):
+    from torchdistx_tpu.fleet import FleetRouter
+
+    model, cfg, params = family
+    engines = [
+        Engine(params, model=model, cfg=cfg, **ENGINE_KW) for _ in range(2)
+    ]
+    router = FleetRouter(
+        engines, ops_port=0, ops_config=ops.OpsConfig(watchdog=False)
+    )
+    url = router.ops_plane.server.url
+    try:
+        code, body = http_get(url + "/healthz")
+        assert code == 200 and len(json.loads(body)["engines"]) == 2
+        # A replica dying (closed out-of-band, then reaped) unwatches.
+        engines[0].close()
+        router.poll()
+        code, body = http_get(url + "/healthz")
+        assert code == 200 and len(json.loads(body)["engines"]) == 1
+        # The retain keeps the plane alive with ZERO engines — a scrape
+        # mid-respawn sees 503, not connection-refused.
+        engines[1].close()
+        router.poll()
+        code, body = http_get(url + "/healthz")
+        assert code == 503
+        assert json.loads(body)["status"] == "unavailable"
+        # A respawn rejoins the same plane.
+        eng3 = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        router.add_replica(eng3)
+        code, _ = http_get(url + "/healthz")
+        assert code == 200
+    finally:
+        router.close()
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(url + "/healthz", timeout=2.0)
+
+
+def test_router_routes_around_stalled_engine(family):
+    """The watchdog marks a wedged engine OVERLOADED; the router's pick
+    must prefer the healthy peer."""
+    from torchdistx_tpu.fleet import FleetRouter
+
+    model, cfg, params = family
+    e0 = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    e1 = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    router = FleetRouter([e0, e1])
+    try:
+        e0._mark_stalled()
+        assert e0.health() is Health.OVERLOADED
+        for _ in range(4):
+            assert router._pick().engine is e1
+    finally:
+        router.close()

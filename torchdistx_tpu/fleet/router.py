@@ -58,6 +58,7 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 from ..resilience.retry import RetryPolicy
+from ..telemetry import ops as _ops
 from ..serving.lifecycle import (
     DeadlineExceeded,
     Health,
@@ -422,6 +423,18 @@ class FleetRouter:
         ``is_retryable`` classifies failures (honoring the
         ``RequestError.retryable`` contract) and whose ``delay``
         schedule paces the hops.  Default: 5 ms base, 250 ms cap.
+    ops_port : opt the whole fleet into the live ops plane
+        (:mod:`torchdistx_tpu.telemetry.ops`): the router get-or-creates
+        the plane on the port and ``retain()``-s it so it outlives
+        replica churn — every replica (current and future) is watched
+        (``/healthz`` entry + stall watchdog + per-tick attribution),
+        reaped/removed replicas unwatch, and :meth:`close` releases the
+        retain, tearing the listener down once the last engine is gone.
+        ``0`` binds an ephemeral port (read it back from
+        ``router.ops_plane.port``).  Default: ``TDX_OPS_PORT`` when
+        set, else off.
+    ops_config : :class:`torchdistx_tpu.telemetry.ops.OpsConfig`,
+        applied when this router CREATES the plane; joiners share as-is.
 
     Single-threaded like the engines it fronts: handles drive their
     bound engine; :meth:`step` advances every live replica (and reaps
@@ -435,6 +448,8 @@ class FleetRouter:
         version: str = "v0",
         max_hops: int = 3,
         retry: Optional[RetryPolicy] = None,
+        ops_port: Optional[int] = None,
+        ops_config: Optional[_ops.OpsConfig] = None,
     ):
         if max_hops < 0:
             raise ValueError("max_hops must be >= 0")
@@ -445,6 +460,16 @@ class FleetRouter:
         self._replicas: Dict[int, Replica] = {}
         self._next_rid = 0
         self._next_key = 0
+        self.ops_plane: Optional[_ops.OpsPlane] = None
+        if ops_port is None:
+            ops_port = _ops.env_ops_port()
+        if ops_port is not None:
+            # Retained: the plane survives windows where every replica
+            # is momentarily gone (kill + respawn, hot swap) — a scrape
+            # mid-churn sees 503, not connection-refused.
+            self.ops_plane = _ops.get_plane(
+                int(ops_port), ops_config
+            ).retain()
         for eng in engines:
             self.add_replica(eng, version=version)
 
@@ -457,6 +482,8 @@ class FleetRouter:
         rid = self._next_rid
         self._next_rid += 1
         self._replicas[rid] = Replica(rid, engine, version)
+        if self.ops_plane is not None and not self.ops_plane.closed:
+            self.ops_plane.watch(engine)
         self._update_ready_gauge()
         return rid
 
@@ -468,6 +495,11 @@ class FleetRouter:
         rep = self._replicas.pop(rid, None)
         if rep is not None and close:
             rep.engine.close()
+        if rep is not None and self.ops_plane is not None:
+            # close()/STOPPED already unwatched via _finish_drain; this
+            # covers the close=False reap of an engine that died without
+            # running its own teardown.  Idempotent.
+            self.ops_plane.unwatch(rep.engine)
         self._update_ready_gauge()
 
     def close_admission(self, rid: int) -> None:
@@ -497,9 +529,14 @@ class FleetRouter:
 
     def close(self) -> None:
         """Retire the whole fleet NOW: every replica engine is closed
-        (outstanding work fails retryable-typed) and dropped."""
+        (outstanding work fails retryable-typed) and dropped; the ops
+        plane's retain is released, so a router-created plane with no
+        other engines shuts its listener down."""
         for rid in list(self._replicas):
             self.remove_replica(rid, close=True)
+        if self.ops_plane is not None:
+            self.ops_plane.release()
+            self.ops_plane = None
 
     # ------------------------------------------------------------------
     # Routing

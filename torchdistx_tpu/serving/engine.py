@@ -121,6 +121,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry as _telemetry
+from ..telemetry import ops as _ops
 from ..models.generate import _sample
 from ..resilience import faults
 from ..resilience import preemption as _preemption
@@ -341,6 +342,23 @@ class Engine:
         churn of fresh ids grows the registry and every exported
         counters snapshot, while a reused id continues the same
         instruments.
+    ops_port : opt into the live ops plane
+        (:mod:`torchdistx_tpu.telemetry.ops`): an HTTP endpoint serving
+        ``/metrics`` (Prometheus text exposition of the whole telemetry
+        registry), ``/healthz`` (this engine's Health; non-200 when not
+        READY/STARTING, connection-refused once STOPPED tore the plane
+        down), and ``/requests`` (live per-request timelines off the
+        flight ring) — plus a stall watchdog thread and the SLO
+        burn-rate monitor, and per-tick utilization attribution gauges
+        (``serve.occupancy``/``serve.prefill_budget``/``serve.page_util``
+        /``serve.churn``/``serve.goodput`` and the ``serve.tick_s``
+        histogram, all ``{engine=...}``-labeled).  ``0`` binds an
+        ephemeral port; engines passing the same non-zero port share
+        one plane.  Default: ``TDX_OPS_PORT`` when set, else off — and
+        off costs nothing per tick (no gauge writes, no allocation).
+    ops_config : :class:`torchdistx_tpu.telemetry.ops.OpsConfig` —
+        watchdog deadline, SLO targets/windows, bind host.  Applies
+        when this engine CREATES the plane; joiners share as-is.
     handle_preemption : install the SIGTERM/SIGINT flag handlers
         (:mod:`torchdistx_tpu.resilience.preemption`) so a preemption
         signal drains the engine; programmatic notice goes through
@@ -380,6 +398,8 @@ class Engine:
         drain_deadline_s: float = 30.0,
         handle_preemption: bool = True,
         engine_id: Optional[str] = None,
+        ops_port: Optional[int] = None,
+        ops_config: Optional[_ops.OpsConfig] = None,
     ):
         self.model = model
         self.cfg = cfg
@@ -543,6 +563,23 @@ class Engine:
         self._health = Health.STARTING
         _G_HEALTH.set(self._health.value)
         self._lg_health.set(self._health.value)
+
+        # Live ops plane (docs/observability.md, "Ops plane").  The
+        # tick counter always counts (one int add — the watchdog's
+        # progress key reads it); everything else — the per-tick
+        # attribution gauges below, the watchdog thread, the HTTP
+        # listener — exists only once a plane is attached (or
+        # ops.enable_tick_attribution() forced attribution on), so the
+        # disabled path pays nothing per tick.
+        self._tick_no = 0
+        self._g_occupancy = None  # per-tick gauges, minted on first use
+        self._ops_plane: Optional[_ops.OpsPlane] = None
+        if ops_port is None:
+            ops_port = _ops.env_ops_port()
+        if ops_port is not None:
+            self._ops_plane = _ops.attach_engine(
+                self, port=int(ops_port), config=ops_config
+            )
 
     # ------------------------------------------------------------------
     # Request tracing (docs/observability.md, "Request tracing")
@@ -822,6 +859,13 @@ class Engine:
             # handle.tokens() loop from spinning a dead engine forever.
             raise EngineDraining("engine is stopped")
         t0 = time.perf_counter()
+        # Ops-plane gate, read once per tick: one attribute read + one
+        # module-global read — the whole cost of the disabled path.
+        ops_on = self._ops_plane is not None or _ops._TICK_ATTRIBUTION
+        churn0 = (
+            self._n_preempt_swap + self._n_preempt_replay
+            + self._n_recoveries
+        ) if ops_on else 0
         if self._health is not Health.DRAINING and _preemption.requested():
             self._begin_drain()
         self._preempted_this_tick = False
@@ -836,8 +880,8 @@ class Engine:
             self._swap_in_phase()
         # Chunks advance even while DRAINING: a slot mid-prefill is
         # in-flight work the drain contract promises to finish.
-        self._advance_prefills()
-        self._decode_phase()
+        chunks = self._advance_prefills()
+        committed = self._decode_phase()
         if self._health is Health.DRAINING:
             self._drain_tick()
         elif self._health is Health.STARTING:
@@ -847,7 +891,11 @@ class Engine:
             queued_chunks=self._pending_prefill_chunks(),
         ):
             self._set_health(Health.READY)
-        self.detector.observe_tick(time.perf_counter() - t0)
+        tick_s = time.perf_counter() - t0
+        self.detector.observe_tick(tick_s)
+        self._tick_no += 1
+        if ops_on:
+            self._tick_telemetry(tick_s, chunks, committed, churn0)
         # A tick that completed the drain must not re-write the routing
         # gauges _finish_drain just cleared — a stopped engine leaves no
         # stale readings behind.  A live engine re-asserts BOTH every
@@ -863,6 +911,65 @@ class Engine:
         n_run = self._n_running()
         _G_RUNNING.set(n_run)
         self._lg_running.set(n_run)
+
+    # ------------------------------------------------------------------
+    # Ops plane: per-tick attribution + the watchdog hook
+
+    def _tick_telemetry(
+        self, tick_s: float, chunks: int, committed: int, churn0: int
+    ) -> None:
+        """Per-tick utilization attribution (docs/observability.md,
+        "Ops plane") — called only with the ops plane attached or
+        attribution forced on.  One reading per signal per tick, all
+        ``{engine=...}``-labeled:
+
+        * ``serve.occupancy`` — decode-batch slots in use / total: how
+          full the one compiled decode chunk ran (queue-bound TTFT shows
+          occupancy near 1; an idle engine shows 0).
+        * ``serve.prefill_budget`` — prefill chunks dispatched / the
+          per-tick budget: prefill-bound ticks pin this at 1.
+        * ``serve.page_util`` — physical page-pool utilization:
+          page-bound admission shows this saturated with occupancy low.
+        * ``serve.churn`` — preemption/swap/recovery events this tick:
+          preemption-bound service shows churn with occupancy high.
+        * ``serve.goodput`` — committed decode tokens per tick-second
+          (the serving analogue of train-side MFU); > 0 whenever the
+          tick decoded, 0 on pure-prefill or idle ticks.
+        * ``serve.tick_s`` — the tick-duration histogram behind the
+          goodput denominator.
+        """
+        if self._g_occupancy is None:
+            eid = self.engine_id
+            self._g_occupancy = _telemetry.gauge("serve.occupancy", engine=eid)
+            self._g_prefill_budget = _telemetry.gauge(
+                "serve.prefill_budget", engine=eid
+            )
+            self._g_page_util = _telemetry.gauge("serve.page_util", engine=eid)
+            self._g_churn = _telemetry.gauge("serve.churn", engine=eid)
+            self._g_goodput = _telemetry.gauge("serve.goodput", engine=eid)
+            self._h_tick = _telemetry.histogram("serve.tick_s", engine=eid)
+        self._g_occupancy.set(round(self._n_decoding() / self.num_slots, 4))
+        self._g_prefill_budget.set(
+            round(chunks / self.max_prefills_per_tick, 4)
+        )
+        self._g_page_util.set(round(self.allocator.utilization(), 4))
+        self._g_churn.set(
+            self._n_preempt_swap + self._n_preempt_replay
+            + self._n_recoveries - churn0
+        )
+        self._g_goodput.set(
+            round(committed / tick_s, 1) if tick_s > 0 and committed else 0
+        )
+        self._h_tick.observe(tick_s)
+
+    def _mark_stalled(self) -> None:
+        """Stall-watchdog hook (:class:`torchdistx_tpu.telemetry.ops
+        .StallWatchdog`, possibly another thread): a wedged engine
+        reads OVERLOADED so a fleet router routes around it.  Its own
+        next real tick — proof the wedge cleared — restores READY via
+        the normal overload re-check."""
+        if self._health in (Health.STARTING, Health.READY):
+            self._set_health(Health.OVERLOADED)
 
     # ------------------------------------------------------------------
     # Lifecycle: reap, drain
@@ -987,6 +1094,15 @@ class Engine:
         _G_EST_TTFT.set(None)
         if self._handle_preemption and not self._handlers_preexisting:
             _preemption.uninstall()
+        # Ops-plane teardown (docs/observability.md, "Ops plane"): a
+        # STOPPED engine leaves the plane — its watchdog stops and its
+        # /healthz entry goes with it; when it was the plane's last
+        # engine (and no router retains it), the HTTP listener shuts
+        # down too: no dangling threads, and the port refuses — the
+        # strongest non-200 /healthz a scraper can observe.
+        if self._ops_plane is not None:
+            self._ops_plane.unwatch(self)
+            self._ops_plane = None
 
     def close(self) -> None:
         """Stop the engine NOW: fail queued and in-flight work with
@@ -1435,11 +1551,12 @@ class Engine:
             n_blocks=len(req.blocks),
         )
 
-    def _advance_prefills(self) -> None:
+    def _advance_prefills(self) -> int:
         """Dispatch up to ``max_prefills_per_tick`` prefill chunks,
         strictly FIFO: the head slot gets the whole budget until its
         prompt completes — that is what bounds a 16k prompt's impact on
-        running streams to one chunk per tick."""
+        running streams to one chunk per tick.  Returns the number of
+        chunks dispatched (the tick's ``serve.prefill_budget`` reading)."""
         budget = self.max_prefills_per_tick
         while budget > 0 and self._prefill_q:
             slot = self._prefill_q[0]
@@ -1454,10 +1571,10 @@ class Engine:
                 # Transient: chunk state is intact (nothing dispatched);
                 # the next tick retries this same chunk.
                 _T_PREFILL_RETRIES.add()
-                return
+                break
             if kind is not None:  # nan: poisoned prefill tick — skip it
                 _T_PREFILL_RETRIES.add()
-                return
+                break
             try:
                 first = self._dispatch_chunk(slot, req, seq, start, end)
             except (KeyboardInterrupt, SystemExit):
@@ -1466,12 +1583,13 @@ class Engine:
                 raise
             except Exception as err:
                 self._on_prefill_failure(req, err)
-                return
+                break
             req.prefill_pos = end
             budget -= 1
             if first is not None:
                 self._prefill_q.pop(0)
                 self._complete_prefill(slot, req, first)
+        return self.max_prefills_per_tick - budget
 
     def _chunk_bucket(self, n: int) -> int:
         """Chunk pad length: next power of two from ``min_prefill_bucket``
@@ -1689,9 +1807,11 @@ class Engine:
     # ------------------------------------------------------------------
     # Decode + the recovery supervisor
 
-    def _decode_phase(self) -> None:
+    def _decode_phase(self) -> int:
+        """One decode chunk over the running slots; returns the number
+        of tokens committed (the tick's ``serve.goodput`` numerator)."""
         if not self._n_decoding():
-            return
+            return 0
         self._decode_no += 1
         try:
             kind = faults.fire("serve.step", self._decode_no)
@@ -1699,13 +1819,13 @@ class Engine:
             # Transient: state untouched, next tick re-runs the chunk —
             # decode is pure, so the retry is token-identical.
             _T_STEP_RETRIES.add()
-            return
+            return 0
         if kind == "nan":
             # Poisoned step: skip BEFORE dispatch (committed state is the
             # prior state bit-identically — the serving analog of the
             # train loop's skip-step guard), count it, keep going.
             _T_SKIPPED.add()
-            return
+            return 0
         sp = _telemetry.start_span(
             "serve.step",
             n_active=self._n_decoding(),
@@ -1737,13 +1857,13 @@ class Engine:
                 # retry — a deterministic error must not spin, so the
                 # second consecutive failure escalates below.
                 _T_STEP_RETRIES.add()
-                return
+                return 0
             # The chunk held the donated cache (or keeps failing): the
             # supervisor rebuilds the pool and replays every live
             # request token-identically, under per-request budgets.
             self._consec_decode_failures = 0
             self._supervise_recovery(err)
-            return
+            return 0
         out = np.asarray(out)  # (chunk, S) — the one host sync per chunk
         self._consec_decode_failures = 0
         dt = time.perf_counter() - t0
@@ -1777,6 +1897,7 @@ class Engine:
         if self._decode_s > 0:
             _G_DECODE_TPS.set(round(self._decode_tokens / self._decode_s, 1))
         sp.end(tokens=committed)
+        return committed
 
     def _pool_lost(self) -> bool:
         """True when a failed donated call consumed the page pool."""
@@ -1997,6 +2118,7 @@ class Engine:
             "requests": self._next_rid,
             "running": self._n_running(),
             "waiting": len(self.scheduler),
+            "ticks": self._tick_no,
             "decode_tokens": self._decode_tokens,
             "decode_s": round(self._decode_s, 4),
             "block_utilization": round(self.allocator.utilization(), 4),

@@ -58,10 +58,14 @@ resume cost (the re-prefill of prompt + generated-so-far) is charged
 like any other work.
 
 Telemetry: the shared ``serve.queue_depth`` gauge plus a per-tenant
-``serve.queue_depth.{tenant}`` gauge family.
+labeled ``serve.queue_depth{tenant=...}`` gauge family (rendered with
+a proper ``tenant`` label on a ``/metrics`` scrape).
 
 State is bounded: tenant counters, empty per-tenant heaps, and gauge
-iteration all prune when a tenant's waiting count hits zero, and a
+iteration all prune when a tenant's waiting count hits zero — the
+pruned tenant's gauge leaves the process-wide registry too
+(:func:`torchdistx_tpu.telemetry.remove`), so the registry and every
+``/metrics`` scrape track ACTIVE tenants, not tenants ever seen — and a
 class whose last waiting request leaves drops its virtual clock and
 every tenant virtual time — the classic busy-period reset (virtual
 time restarts at zero when the system idles; with no one waiting,
@@ -166,17 +170,24 @@ class QoSScheduler:
 
     def _set_gauges(self) -> None:
         _G_QUEUE.set(self._n)
-        # Departed tenants (count pruned to zero) publish a final 0 and
-        # leave the iteration set — the per-op cost tracks ACTIVE
-        # tenants, not tenants ever seen.
+        # Departed tenants (count pruned to zero) leave BOTH the
+        # iteration set and the process-wide registry
+        # (telemetry.remove): a long-lived engine serving free-form
+        # per-user tenant ids must not grow the registry — and with it
+        # every exported counters snapshot and /metrics scrape — by one
+        # gauge per tenant ever seen.  The gauge family is labeled
+        # (serve.queue_depth{tenant=...}), so a Prometheus scrape sees
+        # the tenant as a proper label and idle tenants' series simply
+        # end.
         for tenant in [
             t for t in self._tenant_gauges if t not in self._tenant_n
         ]:
-            self._tenant_gauges.pop(tenant).set(0)
+            del self._tenant_gauges[tenant]
+            _telemetry.remove("serve.queue_depth", tenant=tenant)
         for tenant, n in self._tenant_n.items():
             g = self._tenant_gauges.get(tenant)
             if g is None:
-                g = _telemetry.gauge(f"serve.queue_depth.{tenant}")
+                g = _telemetry.gauge("serve.queue_depth", tenant=tenant)
                 self._tenant_gauges[tenant] = g
             g.set(n)
 

@@ -29,6 +29,7 @@ Instrumenting your own code::
 from ._core import (  # noqa: F401
     Histogram,
     Span,
+    add_listener,
     configure,
     counter,
     counters,
@@ -38,10 +39,14 @@ from ._core import (  # noqa: F401
     event,
     events_enabled,
     flight_dump,
+    flight_records,
     gauge,
     gauges,
     histogram,
     histograms,
+    registry_view,
+    remove,
+    remove_listener,
     reset,
     snapshot,
     span,
@@ -52,6 +57,7 @@ from ._core import (  # noqa: F401
 __all__ = [
     "Histogram",
     "Span",
+    "add_listener",
     "configure",
     "counter",
     "counters",
@@ -61,10 +67,14 @@ __all__ = [
     "event",
     "events_enabled",
     "flight_dump",
+    "flight_records",
     "gauge",
     "gauges",
     "histogram",
     "histograms",
+    "registry_view",
+    "remove",
+    "remove_listener",
     "reset",
     "snapshot",
     "span",

@@ -75,6 +75,7 @@ from typing import Any, Dict, List, Optional, Sequence
 __all__ = [
     "Histogram",
     "Span",
+    "add_listener",
     "configure",
     "counter",
     "counters",
@@ -84,10 +85,14 @@ __all__ = [
     "event",
     "events_enabled",
     "flight_dump",
+    "flight_records",
     "gauge",
     "gauges",
     "histogram",
     "histograms",
+    "registry_view",
+    "remove",
+    "remove_listener",
     "reset",
     "snapshot",
     "span",
@@ -231,6 +236,23 @@ class Histogram:
             cum += c
         return hi_obs  # pragma: no cover — unreachable (cum == total)
 
+    def bucket_counts(self) -> tuple:
+        """One consistent snapshot for exposition: ``(bounds, cumulative
+        bucket counts, total count, sum)`` taken under the histogram's
+        lock, so a concurrent ``observe`` can never tear the invariant
+        the Prometheus format promises (the ``+Inf`` cumulative count
+        equals ``_count``)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        cum = []
+        running = 0
+        for c in counts:
+            running += c
+            cum.append(running)
+        return self.bounds, cum, total, s
+
     def summary(self) -> Dict[str, Any]:
         """``{count, sum, min, max, p50, p95, p99}`` (empty → count 0)."""
         if self._count == 0:
@@ -257,13 +279,46 @@ class Histogram:
         return f"Histogram({self.name}, n={self._count})"
 
 
+def _label_escape(v: Any) -> str:
+    """Escape a label VALUE for the canonical registry name.  Label
+    values are free-form (per-user tenant ids reach ``gauge(...,
+    tenant=...)``), so the structural characters of the ``name{k=v,...}``
+    encoding must not collide with them — a tenant ``"a,b"`` must not
+    parse back as two labels.  Percent-encodes exactly the structural
+    set; ordinary values round-trip unchanged."""
+    return (
+        str(v)
+        .replace("%", "%25")
+        .replace(",", "%2C")
+        .replace("=", "%3D")
+        .replace("{", "%7B")
+        .replace("}", "%7D")
+    )
+
+
+def _label_unescape(v: str) -> str:
+    """Inverse of :func:`_label_escape` (exporters split first, then
+    unescape each value)."""
+    return (
+        v
+        .replace("%7D", "}")
+        .replace("%7B", "{")
+        .replace("%3D", "=")
+        .replace("%2C", ",")
+        .replace("%25", "%")
+    )
+
+
 def _labeled(name: str, labels: Dict[str, Any]) -> str:
     """Canonical registry name for a labeled metric:
-    ``name{k1=v1,k2=v2}`` with keys sorted — the same (name, labels)
-    always resolves to the same instrument."""
+    ``name{k1=v1,k2=v2}`` with keys sorted (values escaped via
+    :func:`_label_escape`) — the same (name, labels) always resolves to
+    the same instrument."""
     if not labels:
         return name
-    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    inner = ",".join(
+        f"{k}={_label_escape(labels[k])}" for k in sorted(labels)
+    )
     return f"{name}{{{inner}}}"
 
 
@@ -289,6 +344,11 @@ class _State:
         self.flight: Optional[deque] = None
         self.flight_path: Optional[str] = None
         self.flight_capacity = 512
+        # In-process record listeners (the ops plane's SLO monitor):
+        # each gets every record as it is emitted.  A registered
+        # listener counts as a recording target — events must be built
+        # for it even with every sink and the flight ring off.
+        self.listeners: List[Any] = []
 
     # -- configuration ------------------------------------------------------
 
@@ -354,10 +414,15 @@ class _State:
         return self.collect or self.jsonl_path is not None
 
     def recording(self) -> bool:
-        """A record built now would land somewhere: a sink OR the
+        """A record built now would land somewhere: a sink, the
         flight-recorder ring (which keeps collecting with every sink
-        off — that is its whole point)."""
-        return self.collect or self.jsonl_path is not None or self.flight is not None
+        off — that is its whole point), or an in-process listener."""
+        return (
+            self.collect
+            or self.jsonl_path is not None
+            or self.flight is not None
+            or bool(self.listeners)
+        )
 
     def record(self, rec: Dict[str, Any]) -> None:
         if self.flight is not None:
@@ -369,6 +434,13 @@ class _State:
         if self.collect:
             self.spans.append(rec)
         self.write_jsonl(rec)
+        for fn in list(self.listeners):
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — telemetry never fails the op
+                _logger.warning(
+                    "telemetry: record listener %r raised", fn, exc_info=True
+                )
 
     def write_jsonl(self, rec: Dict[str, Any]) -> None:
         f = self.jsonl_handle()
@@ -825,6 +897,79 @@ def histogram(
     return h
 
 
+def remove(name: str, **labels) -> bool:
+    """Drop the named instrument (counter, gauge, or histogram — labels
+    as in :func:`counter`) from the registry.  Returns True when
+    something was removed.
+
+    This is the bounded-cardinality valve for *dynamic label families*
+    (``gauge("serve.queue_depth", tenant=...)``, the per-tenant SLO
+    gauges): a long-lived engine serving free-form tenant ids prunes a
+    tenant's instruments when it goes idle, so the registry — and every
+    exported counters snapshot and ``/metrics`` scrape — tracks ACTIVE
+    labels, not labels ever seen.
+
+    Do NOT remove an instrument a module bound at import time (the
+    reason :func:`reset` zeroes in place instead of clearing): the
+    binder would keep counting into an object the registry can no
+    longer see.  Removal is for instruments looked up fresh at each
+    use."""
+    if labels:
+        name = _labeled(name, labels)
+    with _REG_LOCK:
+        found = _state.counters.pop(name, None) is not None
+        found = (_state.gauges.pop(name, None) is not None) or found
+        found = (_state.histograms.pop(name, None) is not None) or found
+    return found
+
+
+def add_listener(fn) -> None:
+    """Register an in-process record listener: ``fn(rec)`` is called
+    with every span/event record as it is emitted (exceptions are
+    swallowed — telemetry never fails the instrumented operation).  A
+    registered listener counts as a recording target
+    (:func:`events_enabled` goes True), so lifecycle events are built
+    for it even with every sink and the flight ring off — the ops
+    plane's SLO monitor consumes the stream this way.  Listeners run
+    on the emitting thread: keep them cheap."""
+    _state.ensure_init()
+    if fn not in _state.listeners:
+        _state.listeners.append(fn)
+
+
+def remove_listener(fn) -> None:
+    """Unregister a record listener (no-op if absent)."""
+    try:
+        _state.listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def flight_records() -> List[Dict[str, Any]]:
+    """Snapshot of the flight-recorder ring's records, oldest first
+    (empty with the recorder off).  Read-only: the ring is untouched —
+    this is the live view the ops plane's ``/requests`` endpoint
+    reconstructs timelines from, between (and without) dumps."""
+    ring = _state.flight
+    if ring is None:
+        return []
+    return [rec for _, rec in list(ring)]
+
+
+def registry_view() -> tuple:
+    """One consistent view of the live instrument registries for an
+    exporter: ``(counters, gauges, histograms)`` as shallow dict copies
+    (name → instrument OBJECT, not value) taken under the registry
+    lock.  Values are read from the objects afterwards — each carries
+    its own lock where torn reads could matter."""
+    with _REG_LOCK:
+        return (
+            dict(_state.counters),
+            dict(_state.gauges),
+            dict(_state.histograms),
+        )
+
+
 def histograms() -> Dict[str, Dict[str, Any]]:
     """Current histogram summaries, name → ``{count, sum, min, max,
     p50, p95, p99}`` (empty histograms report ``{"count": 0}``)."""
@@ -901,7 +1046,10 @@ def reset() -> None:
     Values are zeroed IN PLACE — instrumented modules bind their Counter
     (and Histogram) objects once at import, so dropping registry entries
     would leave them counting into objects :func:`counters` can no
-    longer see."""
+    longer see.  Dynamic label families (per-tenant gauges, per-engine
+    histograms) are looked up fresh at each use instead — those prune
+    via :func:`remove` when their label goes idle, which is what keeps
+    the registry bounded under free-form label values."""
     with _REG_LOCK:
         for c in _state.counters.values():
             with c._lock:
@@ -913,6 +1061,10 @@ def reset() -> None:
     _state.spans.clear()
     if _state.flight is not None:
         _state.flight.clear()
+    # Listeners clear too: a monitor leaked by one test must not keep
+    # events_enabled() True (and the disabled-path pins red) in the
+    # next.  Live ops planes re-subscribe nothing — close them first.
+    _state.listeners.clear()
     # The CALLING thread's nesting/trace stacks clear too: a span
     # abandoned by one test (started, never ended) must not become a
     # phantom parent in the next.
