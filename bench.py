@@ -602,6 +602,15 @@ def bench_serving():
             max_new_tokens=4, key=0,
         )
     warm.drain()
+    # Compile observatory baseline (docs/observability.md, "Perf
+    # plane"): everything below reuses the warm jit cache, so ANY
+    # decode-chunk compile from here on is a steady-state recompile —
+    # the invariant the engine's perf model rests on, asserted at the
+    # end of this bench.
+    compiles_before = {
+        k: v for k, v in telemetry.counters().items()
+        if k.startswith("compile.")
+    }
 
     def run_trace(eng, trace_prompts, trace_outs, trace_arrival):
         peak_util = 0.0
@@ -832,6 +841,30 @@ def bench_serving():
         3,
     )
 
+    # Perf plane (ISSUE 12): per-program compile counts across the
+    # measured phases, the steady-state decode-recompile invariant, and
+    # the HBM ledger's component attribution.  The decode chunk was
+    # compiled by the warm engine; the measured engines share its jit
+    # cache, so a nonzero delta here means shape churn leaked into the
+    # decode path — exactly what the recompile-storm detector guards
+    # live, asserted hard at bench time.
+    compile_counts = {
+        k: v - compiles_before.get(k, 0)
+        for k, v in telemetry.counters().items()
+        if k.startswith("compile.count") and v - compiles_before.get(k, 0)
+    }
+    decode_recompiles = compile_counts.get(
+        "compile.count{program=decode_chunk}", 0
+    )
+    assert decode_recompiles == 0, (
+        f"steady-state decode chunk recompiled {decode_recompiles}x "
+        "during the measured serving phases (shape leak)"
+    )
+    hbm_rows = {
+        k: v for k, v in telemetry.gauges().items()
+        if k.startswith("mem.hbm_bytes")
+    }
+
     tdx_ops.enable_tick_attribution(prev_attr)
     telemetry.configure(**prev_telemetry)
     return {
@@ -867,6 +900,12 @@ def bench_serving():
         },
         "prefix_heavy": prefix,
         "multi_tenant": multi,
+        # Perf plane: what compiled (per program) during the measured
+        # phases, the asserted steady-state invariant, and where the
+        # device bytes sit (the HBM ledger's component attribution).
+        "compile_counts": compile_counts,
+        "decode_recompiles_steady": decode_recompiles,
+        "hbm_bytes": hbm_rows,
     }
 
 

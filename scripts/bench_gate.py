@@ -1,0 +1,339 @@
+#!/usr/bin/env python
+"""Bench regression gate: fresh numbers vs the best of recorded history.
+
+Five rounds of trajectory live in ``BENCH_r0*.json`` and nothing stops
+the next change from quietly regressing the headline serving bench —
+the ROADMAP's ratchet needs a *gate*, not a log line someone might
+read.  This script compares a candidate bench result against the best
+value each metric ever achieved across the history, inside a per-metric
+tolerance band, and emits a machine-readable verdict:
+
+    python scripts/bench_gate.py --candidate BENCH_r05.json
+    python scripts/bench_gate.py --candidate fresh.json --baseline 'BENCH_r0*.json'
+    python scripts/bench_gate.py --run-fast          # CI: CPU-sized scenario
+
+Exit code 0 = every gated metric inside its band; nonzero = regression
+(or a metric the history tracks vanished from the candidate — a bench
+that silently stops reporting a number is itself a regression).
+
+Gated metrics (ISSUE 12): materialize wall (cold + warm), and the
+serving bench's sustained decode tok/s, TTFT p95, TPOT p95, and goodput.
+Metrics absent from ALL history rounds gate vacuously (``no_baseline``)
+— the serving family enters the gate the first round that records it.
+
+``--run-fast`` runs a CPU-sized serving scenario in-process (tiny
+llama, same shape as the chaos soak) and asserts the **compile
+observatory invariants** the full bench also enforces: the decode chunk
+compiles exactly once (steady-state recompiles == 0 — the engine's
+whole perf model rests on it) and the HBM ledger attributes the pool.
+Its JSON row is written to ``--output`` so a CI can archive fast-round
+history; tolerance gating against that history applies when
+``--baseline`` names fast rounds.
+
+File formats accepted: a raw ``bench.py`` line (``{"metric", ...,
+"details": {...}}``) or the archived wrapper (``{"parsed": {...}}``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SERVING = ("details", "serving_llama_350m_continuous")
+
+# (name, path into the bench JSON, higher_is_better, tolerance).
+# Tolerance is the fractional band around the historical best a
+# candidate may sit on the worse side of: generous to start (tunneled-
+# backend wall clocks drift 20-30% between windows — see bench.py's
+# min-of-N discipline); tighten per metric as rounds accumulate.
+METRICS: List[Tuple[str, Tuple[str, ...], bool, float]] = [
+    ("materialize_gpt2xl_s",
+     ("details", "gpt2xl_1p6b_bf16", "ours_s"), False, 0.35),
+    ("materialize_gpt2xl_warm_s",
+     ("details", "gpt2xl_1p6b_bf16", "ours_warm_s"), False, 0.50),
+    ("serving_sustained_decode_tok_s",
+     _SERVING + ("sustained_decode_tokens_per_s",), True, 0.20),
+    ("serving_ttft_p95_s", _SERVING + ("ttft_p95_s",), False, 0.35),
+    ("serving_tpot_p95_s", _SERVING + ("tpot_p95_s",), False, 0.35),
+    ("serving_goodput_tok_s",
+     _SERVING + ("goodput_tokens_per_s",), True, 0.20),
+]
+
+
+def load_bench(path: str) -> Optional[Dict[str, Any]]:
+    """One bench round as its raw result dict, whatever the wrapper."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(doc, dict) and "parsed" in doc:
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or "details" not in doc:
+        return None
+    return doc
+
+
+def extract(doc: Dict[str, Any], path: Tuple[str, ...]) -> Optional[float]:
+    node: Any = doc
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    try:
+        return float(node)
+    except (TypeError, ValueError):
+        return None
+
+
+def best_of(
+    values: List[float], higher_is_better: bool
+) -> Optional[float]:
+    if not values:
+        return None
+    return max(values) if higher_is_better else min(values)
+
+
+def gate(
+    candidate: Dict[str, Any],
+    history: List[Tuple[str, Dict[str, Any]]],
+    tolerance_override: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The verdict: per metric, candidate vs best-of-history inside the
+    tolerance band.  ``pass`` is True iff nothing regressed."""
+    verdict: Dict[str, Any] = {
+        "baseline_rounds": [name for name, _ in history],
+        "metrics": {},
+        "pass": True,
+    }
+    for name, path, higher, tol in METRICS:
+        if tolerance_override is not None:
+            tol = tolerance_override
+        baseline = best_of(
+            [
+                v for _, doc in history
+                if (v := extract(doc, path)) is not None
+            ],
+            higher,
+        )
+        cand = extract(candidate, path)
+        row: Dict[str, Any] = {
+            "baseline_best": baseline,
+            "candidate": cand,
+            "higher_is_better": higher,
+            "tolerance": tol,
+        }
+        if baseline is None:
+            row["status"] = "no_baseline"
+        elif cand is None:
+            # History tracks this number and the candidate stopped
+            # reporting it: the bench itself regressed.
+            row["status"] = "missing_from_candidate"
+            verdict["pass"] = False
+        else:
+            limit = (
+                baseline * (1.0 - tol) if higher else baseline * (1.0 + tol)
+            )
+            row["limit"] = round(limit, 6)
+            ok = cand >= limit if higher else cand <= limit
+            row["status"] = "ok" if ok else "regressed"
+            if baseline and cand:
+                row["vs_best"] = round(
+                    cand / baseline if higher else baseline / cand, 4
+                )
+            if not ok:
+                verdict["pass"] = False
+        verdict["metrics"][name] = row
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+# --run-fast: the CPU-sized serving scenario + observatory invariants
+
+
+def run_fast() -> Dict[str, Any]:
+    """A minutes-not-hours serving round: tiny llama on whatever backend
+    is present (CI: the virtual CPU mesh), reporting the same serving
+    metric names the headline bench feeds the gate — plus the compile
+    observatory's per-program counts, the steady-state decode-recompile
+    invariant, and the HBM ledger rows."""
+    sys.path.insert(0, REPO)
+    import jax
+
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import Engine
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_engine():
+        return Engine(
+            params, model=llama, cfg=cfg, num_slots=4, block_size=8,
+            num_blocks=41, max_model_len=64, decode_chunk=4,
+            handle_preemption=False,
+        )
+
+    rng = np.random.default_rng(0)
+    n_req = 24
+    prompts = [
+        rng.integers(0, cfg.vocab_size, size=int(p)).astype(np.int32)
+        for p in rng.integers(4, 17, size=n_req)
+    ]
+    outs = rng.integers(8, 25, size=n_req)
+    arrival = np.cumsum(rng.poisson(1.0, size=n_req))
+
+    # Warm every program on a throwaway engine; the measured engine
+    # reuses the jit cache, so ANY compile it triggers is a recompile
+    # the steady-state invariant forbids.
+    warm = make_engine()
+    for p in (4, 8, 16):
+        warm.submit(
+            np.arange(1, 1 + p, dtype=np.int32), max_new_tokens=2, key=0
+        )
+    warm.drain()
+    warm.close()
+
+    c0 = telemetry.counters()
+    eng = make_engine()
+    import time
+
+    t0 = time.perf_counter()
+    i = tick = 0
+    while i < n_req or len(eng.scheduler) or eng.stats()["running"]:
+        while i < n_req and arrival[i] <= tick:
+            eng.submit(prompts[i], max_new_tokens=int(outs[i]), key=i)
+            i += 1
+        eng.step()
+        tick += 1
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    c1 = telemetry.counters()
+
+    compile_counts = {
+        k: v - c0.get(k, 0)
+        for k, v in c1.items()
+        if k.startswith("compile.count") and v - c0.get(k, 0)
+    }
+    decode_recompiles = c1.get(
+        "compile.count{program=decode_chunk}", 0
+    ) - c0.get("compile.count{program=decode_chunk}", 0)
+    hbm = {
+        k: v
+        for k, v in telemetry.gauges().items()
+        if k.startswith("mem.hbm_bytes")
+    }
+    eng.close()
+    return {
+        "details": {
+            "serving_llama_350m_continuous": {
+                # The fast scenario reports under the same keys the
+                # headline bench uses, so fast rounds gate against fast
+                # history with the same METRICS table.
+                "sustained_decode_tokens_per_s": st.get(
+                    "decode_tokens_per_s"
+                ),
+                "ttft_p95_s": st.get("ttft_p95_s"),
+                "tpot_p95_s": st.get("tpot_p95_s"),
+                "wall_s": round(wall, 3),
+                "n_requests": n_req,
+                "compile_counts": compile_counts,
+                "decode_recompiles_steady": decode_recompiles,
+                "hbm_bytes": hbm,
+            }
+        },
+        "fast": True,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "--baseline", action="append", default=None,
+        help="history file or glob (repeatable; default BENCH_r0*.json "
+        "in the repo root)",
+    )
+    ap.add_argument("--candidate", help="bench JSON to gate")
+    ap.add_argument(
+        "--run-fast", action="store_true",
+        help="run the CPU-sized serving scenario as the candidate and "
+        "enforce the compile-observatory invariants",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=None,
+        help="override every metric's tolerance band (fraction)",
+    )
+    ap.add_argument("--output", help="write the verdict JSON here too")
+    args = ap.parse_args(argv)
+
+    # --run-fast produces a serving-only row from a DIFFERENT scenario
+    # than the headline bench: it gates against history only when the
+    # caller names fast-round baselines explicitly — never against the
+    # full-bench BENCH_r0* numbers, whose materialize metrics it could
+    # only ever "miss".
+    if args.baseline:
+        patterns = args.baseline
+    elif args.run_fast:
+        patterns = []
+    else:
+        patterns = [os.path.join(REPO, "BENCH_r0*.json")]
+    history: List[Tuple[str, Dict[str, Any]]] = []
+    for pat in patterns:
+        for path in sorted(glob.glob(pat)):
+            doc = load_bench(path)
+            if doc is not None:
+                history.append((os.path.basename(path), doc))
+
+    invariant_failures: List[str] = []
+    if args.run_fast:
+        candidate = run_fast()
+        fast = candidate["details"]["serving_llama_350m_continuous"]
+        if fast["decode_recompiles_steady"] != 0:
+            invariant_failures.append(
+                "steady-state decode recompiles = "
+                f"{fast['decode_recompiles_steady']} (must be 0: the "
+                "decode chunk compiled again after warm-up — shape leak)"
+            )
+        if not fast["hbm_bytes"]:
+            invariant_failures.append(
+                "HBM ledger empty: mem.hbm_bytes{component=} rows missing"
+            )
+    elif args.candidate:
+        candidate = load_bench(args.candidate)
+        if candidate is None:
+            print(
+                f"bench_gate: cannot parse candidate {args.candidate}",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        ap.error("one of --candidate or --run-fast is required")
+        return 2  # pragma: no cover — argparse exits
+
+    verdict = gate(candidate, history, args.tolerance)
+    if args.run_fast:
+        verdict["fast_serving"] = candidate["details"][
+            "serving_llama_350m_continuous"
+        ]
+    if invariant_failures:
+        verdict["pass"] = False
+        verdict["invariant_failures"] = invariant_failures
+
+    out = json.dumps(verdict, indent=2, sort_keys=True)
+    print(out)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -328,8 +328,18 @@ def main() -> int:
             if h.result() != solo(prompt, key, mnt):
                 return fail(f"request {key} diverged from solo generate()")
             n_ok += 1
-    if eng.allocator.num_in_use != 0:
-        return fail(f"soak leaked {eng.allocator.num_in_use} pages")
+    # prefix_cache is now the engine DEFAULT (ISSUE 12): at drain the
+    # allocator may own exactly the index's cached pages — anything
+    # beyond that is a leak, and every indexed page must hold exactly
+    # one reference (zero refcount drift).
+    if eng.allocator.num_in_use != len(eng.prefix):
+        return fail(
+            f"soak leaked pages: {eng.allocator.num_in_use} in use vs "
+            f"{len(eng.prefix)} indexed"
+        )
+    drift = eng.prefix.check(eng.allocator)
+    if drift is not None:
+        return fail(f"soak refcount drift: {drift}")
     if eng.health() is not Health.READY:
         return fail(f"engine health {eng.health()} != READY after soak")
     if eng.stats()["recoveries"] < 1:
@@ -886,11 +896,31 @@ def fleet_main() -> int:
         for name, eng in (
             ("A", eng_a), ("B", eng_b), ("C", eng_c["eng"]),
         ):
-            if eng is not None and eng.allocator.num_in_use != 0:
+            if eng is None:
+                continue
+            # Stopped replicas (A killed, B drained out by the swap)
+            # released their prefix index with the engine; the live
+            # survivor C legitimately owns exactly its cached prefixes
+            # (prefix_cache is the default now) — anything beyond is a
+            # leak, and every indexed page must read refcount 1.
+            indexed = (
+                len(eng.prefix)
+                if eng.prefix is not None
+                and eng.health() is not Health.STOPPED
+                else 0
+            )
+            if eng.allocator.num_in_use != indexed:
                 return (
                     f"[{label}] replica {name} leaked "
-                    f"{eng.allocator.num_in_use} pages"
+                    f"{eng.allocator.num_in_use} pages "
+                    f"({indexed} indexed)"
                 )
+            if indexed:
+                drift = eng.prefix.check(eng.allocator)
+                if drift is not None:
+                    return (
+                        f"[{label}] replica {name} refcount drift: {drift}"
+                    )
         versions = [r.version for r in router.replicas()]
         if versions != ["v2"]:
             return f"[{label}] fleet did not converge on v2: {versions}"

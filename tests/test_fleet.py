@@ -35,9 +35,13 @@ from torchdistx_tpu.serving import (
 )
 
 EOS = 5
+# prefix_cache pinned OFF: these suites assert raw page accounting
+# (num_in_use == 0 at idle) that predates the cache-on default; the
+# cache-on path is covered by the explicit prefix tests and the
+# perf-plane lifecycle test.
 ENGINE_KW = dict(
     num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
-    handle_preemption=False,
+    handle_preemption=False, prefix_cache=False,
 )
 
 
@@ -564,3 +568,75 @@ def test_fleet_mini_chaos_kill_and_swap(family):
     for eng in (eng_a, eng_b, eng_c):
         assert eng.allocator.num_in_use == 0, "pages leaked"
     assert [r.version for r in router.replicas()] == ["v2"]
+
+
+# ---------------------------------------------------------------------------
+# Small-N chaos regressions (ISSUE 12): dead-replica buffers + placement
+# retry through momentary unroutable windows
+
+
+def test_killed_replica_buffer_discarded_not_version_pinned(family):
+    """The TDX_CHAOS_REQUESTS=16 fleet failure, pinned: a stream whose
+    tokens were BUFFERED (never yielded) on a replica that then died
+    must not drain the corpse's buffer at pull time — doing so
+    version-pins the stream to a replica set a hot swap may have
+    already retired, and the pull dies NoReplicaAvailable.  The
+    un-yielded buffer is discarded instead and the stream replays
+    wherever the router can place it, token-identical from the pinned
+    key."""
+    model, cfg, params = family
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+    eng_b.detector.observe_tick(5.0)  # pin least-TTFT routing onto A
+    h = router.submit(prompt_of(6), max_new_tokens=24, key=0)
+    assert h.replica_id == 0
+    for _ in range(3):  # tokens buffer on A; the consumer pulls nothing
+        eng_a.step()
+    assert len(h._inner._tokens) > 0 and not h._inner.done
+    # A dies (device failure + close) and a hot swap retires B before
+    # the handle is ever pulled: zero v1 capacity remains anywhere.
+    for leaf in jax.tree.leaves(eng_a._cache):
+        leaf.delete()
+    eng_a.close()
+    router.poll()
+    eng_c = make_engine(family)
+    hot_swap(router, lambda: eng_c, version="v2")
+    assert [rep.version for rep in router.replicas()] == ["v2"]
+    assert h.result() == solo(model, cfg, params, prompt_of(6), 0, 24)
+    assert h.error is None and h.hops >= 1
+    assert eng_c.allocator.num_in_use == 0
+
+
+def test_placement_retries_through_momentary_unroutable_window(family):
+    """A fleet with no routable replica is routinely a MOMENTARY window
+    (every replica draining mid-swap, a kill reaped an instant before
+    the respawn registers — constant at tiny N): placement must retry
+    with backoff under the hop budget, not fail the request on first
+    sight."""
+    eng = make_engine(family)
+    router = FleetRouter([eng], version="v1", max_hops=4)
+    real_pick = router._pick
+    calls = {"n": 0}
+
+    def flaky_pick(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] <= 2:  # two sightings of an "empty" fleet
+            return None
+        return real_pick(*args, **kwargs)
+
+    router._pick = flaky_pick
+    model, cfg, params = family
+    h = router.submit(prompt_of(4), max_new_tokens=3, key=1)
+    assert calls["n"] >= 3
+    assert h.result() == solo(model, cfg, params, prompt_of(4), 1, 3)
+    assert h.error is None
+
+
+def test_genuinely_empty_fleet_still_fails_typed():
+    """The budget bounds the tolerance: a fleet that STAYS unroutable
+    fails NoReplicaAvailable (typed, retryable) once the placement
+    retries exhaust — never a hang, never a silent drop."""
+    router = FleetRouter([], version="v1", max_hops=2)
+    with pytest.raises(NoReplicaAvailable) as ei:
+        router.submit(prompt_of(4), max_new_tokens=2, key=0)
+    assert ei.value.retryable

@@ -70,7 +70,14 @@ def solo(model, cfg, params, prompt, seed, max_new, *, eos=None,
 # compile and one prefill bucket per sampling config for the whole module
 # (generate/forward_cached compile per static max_new_tokens too, so
 # budgets come from a small fixed menu).
-ENGINE_KW = dict(num_slots=2, block_size=8, max_model_len=64, decode_chunk=4)
+# prefix_cache pinned OFF: these suites assert raw page accounting
+# (num_in_use == 0 at idle) that predates the cache-on default; the
+# cache-on path is covered by the explicit prefix tests and the
+# perf-plane lifecycle test.
+ENGINE_KW = dict(
+    num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
+    prefix_cache=False,
+)
 
 
 def mixed_requests(rng, cfg, n, budgets=(5, 9, 16)):
@@ -310,6 +317,7 @@ def test_engine_backpressure_not_crash():
     eng = Engine(
         params, model=llama, cfg=cfg, num_slots=4, block_size=8,
         num_blocks=5, max_model_len=32, decode_chunk=2,
+        prefix_cache=False,
     )
     handles = [
         eng.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=8, key=i)
@@ -530,7 +538,7 @@ def test_prefix_cache_token_identical(sampled):
     for cache_on in (False, True):
         eng = Engine(
             params, model=llama, cfg=cfg, eos_id=EOS,
-            prefix_cache=cache_on, **sample_kw, **ENGINE_KW,
+            **sample_kw, **{**ENGINE_KW, "prefix_cache": cache_on},
         )
         handles = [
             eng.submit(p, max_new_tokens=9, key=200 + i)
@@ -599,7 +607,7 @@ def test_chunked_prefill_interleaves_decode():
     eng = Engine(
         params, model=llama, cfg=cfg, num_slots=2, block_size=8,
         max_model_len=64, decode_chunk=2, prefill_chunk=4,
-        min_prefill_bucket=4,
+        min_prefill_bucket=4, prefix_cache=False,
     )
     running = eng.submit(np.arange(1, 7, dtype=np.int32), max_new_tokens=40, key=0)
     eng.step()  # running stream admitted and decoding
@@ -637,8 +645,9 @@ def test_cow_divergence_mid_page():
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     before = telemetry.counter("serve.cow_copies").value
     eng = Engine(
-        params, model=llama, cfg=cfg, eos_id=EOS, prefix_cache=True,
-        temperature=0.8, top_k=20, **ENGINE_KW,
+        params, model=llama, cfg=cfg, eos_id=EOS,
+        temperature=0.8, top_k=20,
+        **{**ENGINE_KW, "prefix_cache": True},
     )
     rng = np.random.default_rng(23)
     prompt = rng.integers(0, cfg.vocab_size, size=16).astype(np.int32)  # 2 pages exactly

@@ -243,8 +243,9 @@ class FleetHandle:
     def _bind(self, cause: Optional[BaseException] = None) -> None:
         """Pick a replica and submit there; synchronous typed-retryable
         rejections (shed, draining) try the next candidate.  Every
-        re-submission — whether after a mid-stream failure (``cause``)
-        or a rejected hop — consumes the hop budget.  Raises typed
+        re-submission — whether after a mid-stream failure (``cause``),
+        a rejected hop, or a placement retry against a momentarily
+        unroutable fleet — consumes the hop budget.  Raises typed
         (:class:`FailoverExhausted` / :class:`NoReplicaAvailable` /
         the non-retryable cause) when the request cannot be placed."""
         excluded = set() if self.replica_id is None else {self.replica_id}
@@ -268,8 +269,12 @@ class FleetHandle:
                     self._fail(err)
                     raise err
                 time.sleep(retry.delay(self.hops - 1))
+                # A deadline can expire during the backoff sleeps of a
+                # long placement wait — fail it as its own typed error,
+                # not a generic NoReplicaAvailable at budget exhaustion.
+                self._remaining_deadline_s()
             rep = self._router._pick(exclude=excluded, version=version)
-            if rep is None and excluded and cause is not None:
+            if rep is None and excluded:
                 # Every candidate was excluded by a failed attempt in
                 # THIS binding.  Exclusion only means "not again without
                 # backoff" — the backoff just slept, the replica may
@@ -280,12 +285,37 @@ class FleetHandle:
                 excluded = set()
                 rep = self._router._pick(exclude=excluded, version=version)
             if rep is None:
+                if self.hops < self._max_hops:
+                    # A fleet with NO routable replica is routinely a
+                    # momentary window, not a verdict: every replica
+                    # draining mid-hot-swap, a killed engine reaped an
+                    # instant before its respawn registers, a tiny fleet
+                    # whose only peer is busy churning.  Chaos at small
+                    # N hits these windows constantly.  Placement
+                    # retries with backoff under the same hop budget —
+                    # the loop head sleeps, re-checks the deadline, and
+                    # re-picks — and only a fleet that STAYS unroutable
+                    # for the whole budget fails typed below.
+                    if cause is None:
+                        cause = NoReplicaAvailable(
+                            "no routable replica (momentary?); retrying "
+                            f"placement (hop {self.hops + 1}/"
+                            f"{self._max_hops})"
+                        )
+                    if t_fail is None:
+                        # The binding's first obstacle was an unroutable
+                        # fleet: the added-latency clock starts here.
+                        t_fail = time.perf_counter()
+                    continue
                 err = NoReplicaAvailable(
                     "no replica can take the request"
                     + (f" (version-pinned to {version!r})" if version else "")
                     + f" after {self.hops} hop(s)"
                 )
-                err.__cause__ = cause
+                if cause is not None and not isinstance(
+                    cause, NoReplicaAvailable
+                ):
+                    err.__cause__ = cause
                 self._fail(err)
                 raise err
             try:
@@ -345,6 +375,23 @@ class FleetHandle:
                     raise self.error
                 return
             inner = self._inner
+            inner_err = getattr(inner, "error", None)
+            if (
+                inner_err is not None
+                and not self._cancelled
+                and self._router.retry.is_retryable(inner_err)
+            ):
+                # The bound engine already failed this request before we
+                # consumed its stream (killed mid-load, closed, drained)
+                # — tokens it BUFFERED but never yielded to the consumer
+                # are discarded, not drained: consuming them would
+                # version-pin the stream to a replica set that may
+                # already be gone (the small-N kill-then-hot-swap chaos
+                # failure), while the replay is token-identical from the
+                # pinned key anyway.  Tokens already yielded in earlier
+                # pulls stay committed and are prefix-verified below.
+                self._bind(cause=inner_err)
+                continue
             n_skip = len(self._committed)
             i = 0
             try:

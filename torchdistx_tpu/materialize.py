@@ -60,6 +60,7 @@ import torch.utils._pytree as pytree
 
 from . import _tape
 from . import telemetry as _telemetry
+from .telemetry import perf as _perf
 from ._tape import OpNode, OutputRef
 from .deferred_init import _get_record, is_deferred
 from .fake import FakeTensor
@@ -1066,7 +1067,8 @@ def materialize_tensor_jax(
     _check_guards_of(record.node)
     from .utils.compilation_cache import cache_everything
 
-    with _telemetry.span("materialize.tensor"), cache_everything():
+    with _telemetry.span("materialize.tensor"), cache_everything(), \
+            _perf.program("materialize"):
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -1386,19 +1388,33 @@ def materialize_module_jax(
     _sp_call = _telemetry.start_span("materialize.module", strategy=strategy)
     _sp_plan = _telemetry.start_span("materialize.plan")
     try:
-        return _materialize_module_jax(
-            module,
-            mesh=mesh,
-            plan=plan,
-            seed=seed,
-            dtype=dtype,
-            rng_impl=rng_impl,
-            strategy=strategy,
-            _fallback_torch=_fallback_torch,
-            _sp_call=_sp_call,
-            _sp_plan=_sp_plan,
-        )
+        # Compile observatory: every XLA compile this materialization
+        # issues on THIS thread (the fused program, the per-job jits of
+        # the execute phase) attributes to program="materialize" via the
+        # jax.monitoring listener; the grouped compile pool's worker
+        # threads scope themselves inside _build.
+        with _perf.program("materialize"):
+            return _materialize_module_jax(
+                module,
+                mesh=mesh,
+                plan=plan,
+                seed=seed,
+                dtype=dtype,
+                rng_impl=rng_impl,
+                strategy=strategy,
+                _fallback_torch=_fallback_torch,
+                _sp_call=_sp_call,
+                _sp_plan=_sp_plan,
+            )
     except BaseException as e:
+        if _perf.is_oom(e):
+            # The OOM post-mortem: which component held the device when
+            # materialization could not fit (a serving engine's pool and
+            # weights share the chip with this allocation).
+            _perf.oom_dump(
+                "device_oom", site="materialize",
+                error=f"{type(e).__name__}: {e}",
+            )
         if _sp_plan.duration is None:
             _sp_plan.cancel()
         if _sp_call.duration is None:
@@ -2056,7 +2072,19 @@ def _materialize_module_jax(
                     if osh is not None
                     else jax.jit(fn)
                 )
-                cfn = jfn.lower(*args).compile()
+                # Observatory scope per worker thread: the monitoring
+                # listener attributes the backend compile precisely;
+                # without monitoring, ensure_counted records the
+                # lower+compile wall time instead — exactly once either
+                # way.  (A persistent-cache hit compiles nothing and
+                # deserializes in milliseconds; it still counts as a
+                # program load, which is what the count family tracks.)
+                import time as _time
+
+                _t0 = _time.perf_counter()
+                with _perf.program("materialize") as _sc:
+                    cfn = jfn.lower(*args).compile()
+                _sc.ensure_counted(_time.perf_counter() - _t0)
                 _T_COMPILES.add()
                 if key is not None:
                     _exec_cache_put(key, cfn)

@@ -102,6 +102,29 @@ class BlockAllocator:
         """Fraction of allocatable pages currently owned (physical)."""
         return len(self._ref) / self.capacity
 
+    def fragmentation(self) -> float:
+        """Scatter of the free map in ``[0, 1]``: 0 when every free page
+        sits in one contiguous id run (or nothing/everything is free),
+        approaching 1 as the free pages splinter into single-page holes
+        between allocations.  Paged attention itself is indifferent to
+        contiguity — this is the *observability* estimate the HBM
+        ledger exports (``mem.pool_fragmentation``): a pool that stays
+        shattered under churn is a pool whose holes the allocator keeps
+        cutting, the early signature of admission patterns that thrash
+        pages.  Computed as ``1 - largest_free_run / num_free`` over
+        sorted page ids — O(num_free), called per tick only with the
+        ops plane attached."""
+        n = len(self._free)
+        if n <= 1:
+            return 0.0
+        ids = sorted(self._free)
+        best = run = 1
+        for a, b in zip(ids, ids[1:]):
+            run = run + 1 if b == a + 1 else 1
+            if run > best:
+                best = run
+        return 1.0 - best / n
+
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import perf as _perf
 from .blocks import TRASH_BLOCK
 
 __all__ = [
@@ -67,7 +68,19 @@ def init_paged_cache(model, cfg, num_blocks: int, block_size: int):
             dtype=leaf.dtype,
         )
 
-    return jax.tree.map(page, proto)
+    try:
+        return jax.tree.map(page, proto)
+    except Exception as err:
+        # The pool allocation is the single biggest HBM bite the serving
+        # stack takes; a RESOURCE_EXHAUSTED here must carry the ledger
+        # (what already holds the device) into the flight record.
+        if _perf.is_oom(err):
+            _perf.oom_dump(
+                "device_oom", site="cache.init_paged_cache",
+                num_blocks=num_blocks, block_size=block_size,
+                error=f"{type(err).__name__}: {err}",
+            )
+        raise
 
 
 def fresh_pool(paged):
@@ -81,7 +94,17 @@ def fresh_pool(paged):
     every live request into the fresh pool, so zeroed is the correct
     initial state, exactly as at engine construction.
     """
-    return jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), paged)
+    try:
+        return jax.tree.map(lambda x: jnp.zeros(x.shape, x.dtype), paged)
+    except Exception as err:
+        if _perf.is_oom(err):
+            # Recovery could not even re-carve the pool: the one OOM
+            # that ends the engine — dump what held the memory.
+            _perf.oom_dump(
+                "device_oom", site="cache.fresh_pool",
+                error=f"{type(err).__name__}: {err}",
+            )
+        raise
 
 
 @partial(jax.jit, static_argnames=("block_size",), donate_argnums=(0,))

@@ -111,6 +111,7 @@ drop-and-replay.  ``fatal`` propagates everywhere: fatal means fatal.
 from __future__ import annotations
 
 import itertools
+import threading
 import time
 from contextlib import nullcontext
 from functools import partial
@@ -122,6 +123,7 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 from ..telemetry import ops as _ops
+from ..telemetry import perf as _perf
 from ..models.generate import _sample
 from ..resilience import faults
 from ..resilience import preemption as _preemption
@@ -178,6 +180,16 @@ _G_HEALTH = _telemetry.gauge("serve.health")
 # ("eng0", "eng1", ...) for its per-engine metrics and trace context,
 # unless the caller names it (Engine(engine_id="replica-a")).
 _ENGINE_SEQ = itertools.count()
+
+# Live engines per weights-ledger key: N replicas over one params pytree
+# register "weights" once, and the bytes leave the ledger when the LAST
+# engine using that pytree stops — a hot-swapped fleet's retired model
+# versions must not pile up on mem.hbm_bytes{component=weights} forever
+# (that would corrupt exactly the OOM forensics the ledger exists for).
+# Locked: construction and teardown may race across threads, and a lost
+# refcount update would retire a serving version's bytes early.
+_WEIGHTS_REFS: dict = {}
+_WEIGHTS_LOCK = threading.Lock()
 
 
 @partial(
@@ -264,6 +276,19 @@ def _decode_chunk(
     return paged, out
 
 
+# Compile observatory (docs/observability.md, "Perf plane"): the three
+# compiled programs under stable labels — decode must compile exactly
+# once per engine shape (the steady-state invariant the recompile-storm
+# detector guards), prefill once per chunk bucket.  Late-bound through
+# the module globals so the chaos tests' monkeypatched stand-ins
+# (``engine._decode_chunk = flaky``) keep working, uninstrumented.
+_JP_PREFILL = _perf.JitProgram(lambda: _prefill_chunk, "prefill_chunk")
+_JP_PREFILL_LAST = _perf.JitProgram(
+    lambda: _prefill_chunk_last, "prefill_chunk_last"
+)
+_JP_DECODE = _perf.JitProgram(lambda: _decode_chunk, "decode_chunk")
+
+
 class Engine:
     """Continuous-batching serving engine over one model family.
 
@@ -301,9 +326,14 @@ class Engine:
     prefix_cache : content-address full prompt pages in a refcounted LRU
         index so requests sharing a cached prefix skip its prefill
         (copy-on-write on divergence, LRU eviction under pressure).
-        Off by default: sharing keeps finished requests' pages resident,
-        which changes ``num_in_use`` accounting that embedding code may
-        assert on; outputs are token-identical either way.
+        ON by default: outputs are token-identical either way and
+        eviction is admission-safe (the cache can never cause a stall
+        an empty cache would not).  Opt out (``False``) for code that
+        asserts on raw ``num_in_use`` accounting — sharing keeps
+        finished requests' full prompt pages resident in the index
+        (``num_in_use == len(engine.prefix)`` at idle, every indexed
+        page refcount 1) until pressure evicts them or the engine
+        stops.
     scheduler : ``"fifo"`` (default — byte-identical to the pre-QoS
         engine) or ``"qos"`` (:class:`.qos.QoSScheduler`: strict
         priority classes, per-tenant weighted fair queueing over
@@ -386,7 +416,7 @@ class Engine:
         decode_chunk: int = 8,
         max_prefills_per_tick: int = 1,
         prefill_chunk: int = 512,
-        prefix_cache: bool = False,
+        prefix_cache: bool = True,
         min_prefill_bucket: int = 16,
         scheduler: str = "fifo",
         tenant_weights: Optional[dict] = None,
@@ -484,6 +514,24 @@ class Engine:
         prep = getattr(model, "prep_decode", None)
         self._params = prep(params, cfg) if prep is not None else params
         self._cache = init_paged_cache(model, cfg, num_blocks, block_size)
+        self._pool_nbytes = _perf.pytree_nbytes(self._cache)
+        self._page_nbytes = self._pool_nbytes // max(1, num_blocks)
+        self._swap_host_bytes = 0
+        # The weights-ledger anchor: the identity of the CALLER's first
+        # params leaf, not the prepped tree (prep_decode mints a fresh
+        # pytree per engine, so N replicas constructed from one
+        # materialized pytree would otherwise register N times).  The
+        # anchor leaf is RETAINED so the id cannot be recycled onto a
+        # different weight set while this engine lives (a collided key
+        # would merge two versions' bytes into one entry); one leaf,
+        # not the whole raw tree — the prepped tree shares most leaves
+        # anyway.  Registration itself happens at the END of __init__,
+        # after everything fallible: a constructor that raises (ops
+        # port in use, signal handlers off the main thread) must not
+        # leak ledger entries no _finish_drain will ever release.
+        leaves = jax.tree.leaves(params)
+        self._weights_anchor = leaves[0] if leaves else params
+        self._weights_key = f"params:{id(self._weights_anchor)}"
 
         s = num_slots
         self._slot_req: list[Optional[Request]] = [None] * s
@@ -580,6 +628,28 @@ class Engine:
             self._ops_plane = _ops.attach_engine(
                 self, port=int(ops_port), config=ops_config
             )
+
+        # Perf plane (docs/observability.md, "Perf plane"), LAST —
+        # nothing after this can raise, so every registration is
+        # balanced by _finish_drain: arm the compile observatory and
+        # put this engine's device bytes on the HBM ledger.  Weights
+        # dedupe by params identity (refcounted — N replicas over one
+        # materialized pytree are one copy of HBM, retiring with the
+        # last of them); the pool is per engine.  kv_swap_host /
+        # prefix_cache_held are live accounts, synced per tick (ops
+        # plane on) and at every OOM dump.
+        _perf.install_monitoring()
+        with _WEIGHTS_LOCK:
+            _WEIGHTS_REFS[self._weights_key] = (
+                _WEIGHTS_REFS.get(self._weights_key, 0) + 1
+            )
+        _perf.ledger.register(
+            "weights", _perf.pytree_nbytes(self._params),
+            owner=self._weights_key,
+        )
+        _perf.ledger.register(
+            "kv_pool", self._pool_nbytes, owner=self.engine_id
+        )
 
     # ------------------------------------------------------------------
     # Request tracing (docs/observability.md, "Request tracing")
@@ -937,6 +1007,10 @@ class Engine:
           tick decoded, 0 on pure-prefill or idle ticks.
         * ``serve.tick_s`` — the tick-duration histogram behind the
           goodput denominator.
+        * ``mem.pool_fragmentation`` — free-map scatter of the page
+          pool (the HBM ledger's fragmentation estimate), plus a ledger
+          sync of the live ``kv_swap_host`` / ``prefix_cache_held``
+          accounts.
         """
         if self._g_occupancy is None:
             eid = self.engine_id
@@ -948,6 +1022,15 @@ class Engine:
             self._g_churn = _telemetry.gauge("serve.churn", engine=eid)
             self._g_goodput = _telemetry.gauge("serve.goodput", engine=eid)
             self._h_tick = _telemetry.histogram("serve.tick_s", engine=eid)
+            self._g_frag = _telemetry.gauge(
+                "mem.pool_fragmentation", engine=eid
+            )
+        if self._tick_no % 16 == 1:  # tick_no pre-incremented: first tick writes
+            # The free-map scan is O(free pages log free pages): a
+            # sampled gauge (every 16th tick) keeps the instrumentation
+            # from taxing the tick latency it exists to explain.
+            self._g_frag.set(round(self.allocator.fragmentation(), 4))
+        self._ledger_sync()
         self._g_occupancy.set(round(self._n_decoding() / self.num_slots, 4))
         self._g_prefill_budget.set(
             round(chunks / self.max_prefills_per_tick, 4)
@@ -970,6 +1053,51 @@ class Engine:
         the normal overload re-check."""
         if self._health in (Health.STARTING, Health.READY):
             self._set_health(Health.OVERLOADED)
+
+    # ------------------------------------------------------------------
+    # Perf plane: HBM ledger sync + OOM forensics
+
+    def _ledger_sync(self) -> None:
+        """Refresh this engine's live ledger accounts: host-resident
+        swap staging and the pages the prefix index holds (the latter a
+        view INSIDE ``kv_pool`` — attribution, not additional HBM).
+        Called per tick with the ops plane on, and before every OOM
+        dump so the forensic snapshot is current."""
+        _perf.ledger.register(
+            "kv_swap_host", self._swap_host_bytes, owner=self.engine_id
+        )
+        if self.prefix is not None:
+            _perf.ledger.register(
+                "prefix_cache_held",
+                len(self.prefix) * self._page_nbytes,
+                owner=self.engine_id,
+            )
+
+    def _oom_check(self, err: BaseException, site: str) -> None:
+        """RESOURCE_EXHAUSTED forensics: when a failed device call is a
+        device OOM, snapshot the HBM ledger into the flight record —
+        the post-mortem then reads *what held the memory* (weights vs
+        pool vs swap vs cached prefixes), not just that it ran out."""
+        if _perf.is_oom(err):
+            self._ledger_sync()
+            _perf.oom_dump(
+                "device_oom", engine=self.engine_id, site=site,
+                error=f"{type(err).__name__}: {err}",
+                pool_fragmentation=round(self.allocator.fragmentation(), 4),
+            )
+
+    def _pool_exhausted(self, site: str, need: int) -> None:
+        """Page-pool exhaustion forensics: a reservation the admission
+        quota promised could not be met (allocator map changed under
+        the tick, CoW under chronic pressure).  Same ledger-carrying
+        flight dump as a device OOM, under ``reason="pool_exhausted"``."""
+        self._ledger_sync()
+        _perf.oom_dump(
+            "pool_exhausted", engine=self.engine_id, site=site,
+            pages_needed=need, pages_free=self.allocator.num_free,
+            pages_in_use=self.allocator.num_in_use,
+            pool_fragmentation=round(self.allocator.fragmentation(), 4),
+        )
 
     # ------------------------------------------------------------------
     # Lifecycle: reap, drain
@@ -1103,6 +1231,21 @@ class Engine:
         if self._ops_plane is not None:
             self._ops_plane.unwatch(self)
             self._ops_plane = None
+        # HBM ledger teardown: a stopped engine's pool/swap/prefix
+        # accounts leave the ledger; weights leave when the LAST engine
+        # sharing the params pytree stops (peers may still serve it).
+        _perf.ledger.unregister("kv_pool", owner=self.engine_id)
+        _perf.ledger.unregister("kv_swap_host", owner=self.engine_id)
+        _perf.ledger.unregister("prefix_cache_held", owner=self.engine_id)
+        with _WEIGHTS_LOCK:
+            left = _WEIGHTS_REFS.get(self._weights_key, 1) - 1
+            if left <= 0:
+                _WEIGHTS_REFS.pop(self._weights_key, None)
+            else:
+                _WEIGHTS_REFS[self._weights_key] = left
+        if left <= 0:
+            _perf.ledger.unregister("weights", owner=self._weights_key)
+        self._weights_anchor = None  # release the id pin with the entry
 
     def close(self) -> None:
         """Stop the engine NOW: fail queued and in-flight work with
@@ -1345,13 +1488,15 @@ class Engine:
                 raise
             except faults.FatalInjectedFault:
                 raise
-            except Exception:
+            except Exception as err:
                 # The gather is read-only: device state is untouched,
                 # so drop-and-replay below is safe and token-identical.
-                pass
+                self._oom_check(err, "serve.swap_out")
             else:
                 self.allocator.swap_out(priv)
                 self._swapped[slot] = (host, layout)
+                if host is not None:
+                    self._swap_host_bytes += _perf.pytree_nbytes(host)
                 req.blocks = None
                 self._tables[slot] = 0
                 self._done[slot] = True
@@ -1386,7 +1531,9 @@ class Engine:
         request was cancelled, failed, or re-preempted to replay): the
         kept shared pages' references release, the host buffer is
         dropped, and the allocator forgets the host-resident rows."""
-        _, layout = self._swapped.pop(slot)
+        host, layout = self._swapped.pop(slot)
+        if host is not None:
+            self._swap_host_bytes -= _perf.pytree_nbytes(host)
         kept = [blk for blk in layout if blk is not None]
         if kept:
             self.allocator.free(kept)
@@ -1435,10 +1582,15 @@ class Engine:
                     # replays everything (swapped slots included, as
                     # replays).  The just-granted pages die with the
                     # map.
+                    self._oom_check(err, "serve.swap_in")
                     self._swapped.pop(slot, None)
+                    if host is not None:
+                        self._swap_host_bytes -= _perf.pytree_nbytes(host)
                     self._supervise_recovery(err)
                     return
             del self._swapped[slot]
+            if host is not None:
+                self._swap_host_bytes -= _perf.pytree_nbytes(host)
             fresh = iter(pages)
             blocks = [
                 kept if kept is not None else next(fresh)
@@ -1515,6 +1667,7 @@ class Engine:
             # mid-tick); undo the share and let the caller requeue.
             if shared:
                 self.allocator.free(shared)
+            self._pool_exhausted("serve.start_prefill", n_total - len(shared))
             raise RuntimeError("prefill could not reserve its promised pages")
         if cached_len and not req.hit_counted:
             # Counted once per REQUEST, not per admission attempt — a
@@ -1619,6 +1772,7 @@ class Engine:
                 continue
             fresh = self._alloc_pages(1)
             if fresh is None:
+                self._pool_exhausted("serve.cow", 1)
                 raise RuntimeError("copy-on-write could not reserve a page")
             self._cache = copy_pages(
                 self._cache, np.int32(page), np.int32(fresh[0])
@@ -1639,14 +1793,16 @@ class Engine:
         tokens[0, :n] = seq[start:end]
         pos = np.full((1,), start, np.int32)
         if end >= len(seq):
-            first, self._cache = _prefill_chunk_last(
+            first, self._cache = _JP_PREFILL_LAST.call(
+                self, f"prefill_chunk_last:b{bucket}",
                 self._params, self._cache, tokens, pos,
                 np.int32(end - 1 - start), key, table,
                 model=self.model, cfg=self.cfg,
                 temperature=self.temperature, top_k=self.top_k,
             )
             return int(first)
-        self._cache = _prefill_chunk(
+        self._cache = _JP_PREFILL.call(
+            self, f"prefill_chunk:b{bucket}",
             self._params, self._cache, tokens, pos, table,
             model=self.model, cfg=self.cfg,
         )
@@ -1755,6 +1911,7 @@ class Engine:
         budget and restart its prefill from the FIFO head — together
         with every prefill admitted behind it, so the failure cannot
         cost anyone their place in line."""
+        self._oom_check(err, "serve.prefill")
         if self._pool_lost():
             self._supervise_recovery(err)
             return
@@ -1785,10 +1942,10 @@ class Engine:
         Returns ``(sampled_token, table)``.  No prefix-index interaction:
         replays only run against a freshly-reset pool, where the index
         is empty by definition."""
-        blocks = self._alloc_pages(
-            blocks_needed(req.cache_tokens, self.block_size)
-        )
+        need = blocks_needed(req.cache_tokens, self.block_size)
+        blocks = self._alloc_pages(need)
         if blocks is None:  # admission reserved cumulatively / allocator reset
+            self._pool_exhausted("serve.replay_prefill", need)
             raise RuntimeError("prefill could not reserve its promised pages")
         req.blocks = blocks
         table = np.zeros((self._table_width,), np.int32)
@@ -1833,7 +1990,8 @@ class Engine:
         )
         t0 = time.perf_counter()
         try:
-            self._cache, out = _decode_chunk(
+            self._cache, out = _JP_DECODE.call(
+                self, None,
                 self._params, self._cache,
                 self._tokens, self._positions, self._n_gen, self._done,
                 self._keys, self._tables,
@@ -1849,6 +2007,7 @@ class Engine:
             raise
         except Exception as err:
             sp.cancel()
+            self._oom_check(err, "serve.step")
             self._consec_decode_failures += 1
             if not self._pool_lost() and self._consec_decode_failures <= 1:
                 # The donation was not consumed and nothing committed:
@@ -1975,6 +2134,7 @@ class Engine:
         # decoding slot.  The allocator reset below re-zeroes the swap
         # account along with the ownership map.
         self._swapped.clear()
+        self._swap_host_bytes = 0
         if self.prefix is not None:
             self.prefix.clear()
         pending = [

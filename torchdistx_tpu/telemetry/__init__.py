@@ -30,6 +30,7 @@ from ._core import (  # noqa: F401
     Histogram,
     Span,
     add_listener,
+    on_reset,
     configure,
     counter,
     counters,
@@ -58,6 +59,7 @@ __all__ = [
     "Histogram",
     "Span",
     "add_listener",
+    "on_reset",
     "configure",
     "counter",
     "counters",

@@ -76,6 +76,7 @@ __all__ = [
     "Histogram",
     "Span",
     "add_listener",
+    "on_reset",
     "configure",
     "counter",
     "counters",
@@ -1039,6 +1040,21 @@ def emit_counters() -> None:
     _state.write_jsonl(rec)
 
 
+# Sibling modules holding derived telemetry state (the perf plane's
+# storm windows and HBM ledger) register a hook here so reset() clears
+# them with the registries — a storm latched by one test must not stay
+# latched into the next.
+_RESET_HOOKS: List[Any] = []
+
+
+def on_reset(fn) -> None:
+    """Register ``fn()`` to run at the end of every :func:`reset`
+    (idempotent per function; exceptions are swallowed — reset is test
+    plumbing, not a failure path)."""
+    if fn not in _RESET_HOOKS:
+        _RESET_HOOKS.append(fn)
+
+
 def reset() -> None:
     """Zero all counters/gauges/histograms and clear collected spans and
     the flight ring (tests).
@@ -1072,6 +1088,11 @@ def reset() -> None:
         stack = getattr(_tls, attr, None)
         if stack:
             stack.clear()
+    for fn in list(_RESET_HOOKS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — reset is test plumbing
+            pass
 
 
 def _flush_at_exit() -> None:  # pragma: no cover — interpreter teardown

@@ -1,0 +1,540 @@
+"""Perf plane: compile observatory + HBM ledger with OOM forensics.
+
+PR 9/10 made the *request* path transparent; this module opens the
+*device* side — the two costs that actually sink a serving engine or a
+materialization and that nothing upstream could attribute:
+
+**Compile observatory.**  XLA compile time dominates materialization
+cost (the very fact ``utils/compilation_cache.py`` exists for), and the
+serving engine's whole performance model rests on ONE compiled decode
+chunk — a shape leak that recompiles it per tick shows up only as
+mysteriously cratered tok/s.  Three labeled families make both visible:
+
+* ``compile.count{program=}`` — compiles per program label,
+* ``compile.time_s{program=}`` — compile-duration histogram,
+* ``compile.recompiles{program=}`` — compiles beyond a program's first.
+
+Attribution is two-layered.  :class:`JitProgram` wraps a jitted callable
+under a stable label and detects (re)compiles exactly, via the jit
+cache-size delta around each call — donation, tracing, and monkeypatched
+stand-ins (chaos tests swap the decode chunk for a flaky double) all
+pass through untouched.  Where the running JAX exposes
+``jax.monitoring`` duration events (:func:`install_monitoring`, hooked
+by ``ensure_compilation_cache``), the listener supplies the precise
+backend-compile duration and catches every compile *outside* a wrapped
+call too (attributed to the ambient :func:`program` scope, else
+``other``); without it, call wall time is the fallback.  Every event
+lands exactly once: a scope in which the listener already counted
+suppresses the fallback.
+
+The **recompile-storm detector** rides the recompile counter: the same
+program recompiled ``TDX_RECOMPILE_STORM_N`` times (default 3) inside
+``TDX_RECOMPILE_STORM_WINDOW_S`` (default 30 s) latches
+``serve.recompile_storm{engine=}``, dumps the flight recorder with
+``reason="recompile_storm"``, and marks the owning engine OVERLOADED
+(the stall-watchdog convention: a fleet router routes around it; the
+latch clears once the program goes a full window without recompiling).
+A shape leak in the decode chunk is caught live, not in next week's
+bench.
+
+**HBM ledger.**  Device memory is spent by four subsystems — weights,
+the paged KV pool, swap staging, prefix-cache-held pages — and a
+``RESOURCE_EXHAUSTED`` names none of them.  :data:`ledger` attributes
+bytes per component as ``mem.hbm_bytes{component=}`` gauges
+(``register``/``unregister``; multiple owners of one component sum, and
+shared ownership — N engines over one params pytree — dedupes by owner
+key).  :func:`oom_dump` snapshots the ledger into the flight record
+(``reason="device_oom"`` / ``"pool_exhausted"``) so an OOM post-mortem
+reads *what held the memory*, not just that it ran out; :func:`is_oom`
+classifies the error strings XLA actually raises.
+
+Like the rest of telemetry: dependency-light (jax imported lazily, only
+by the monitoring hookup), never fails the instrumented operation, and
+free when nothing records — the non-compile fast path of a wrapped call
+is two ints and a perf_counter.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import _core
+
+__all__ = [
+    "JitProgram",
+    "Ledger",
+    "install_monitoring",
+    "is_oom",
+    "ledger",
+    "monitoring_installed",
+    "oom_dump",
+    "program",
+    "pytree_nbytes",
+    "record_compile",
+    "storm_config",
+]
+
+_T_OOMS = _core.counter("mem.ooms")
+_T_STORMS = _core.counter("serve.recompile_storms")
+
+# Substrings of the errors XLA actually raises when device memory runs
+# out (XlaRuntimeError carries the grpc-style status name).
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "RESOURCE EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OutOfMemory",
+)
+
+
+# ---------------------------------------------------------------------------
+# Program attribution scopes + the jax.monitoring hookup
+
+_tls = threading.local()
+
+# The jax.monitoring duration-event names that mean "XLA compiled a
+# program" across the jax versions this stack supports.
+_COMPILE_EVENTS = (
+    "/jax/core/compile/backend_compile_duration",
+    "/jax/core/compile/backend_compile_duration_sec",
+)
+
+_install_lock = threading.Lock()
+_monitoring = False  # listener registered successfully
+
+
+class _Scope:
+    """One thread's ambient program label for compile attribution.
+
+    ``counted`` flips when the monitoring listener lands an event inside
+    the scope, so the scope owner's wall-time fallback
+    (:meth:`ensure_counted`) never double-counts a compile the listener
+    already recorded precisely.  ``track`` marks labels that denote ONE
+    program identity (the :class:`JitProgram` scopes): only those feed
+    the recompile counter and the storm detector — a broad label like
+    ``materialize`` or ``other`` covers many distinct programs, whose
+    second compile is not a recompile of anything."""
+
+    __slots__ = ("label", "owner", "counted", "track")
+
+    def __init__(self, label: str, owner: Any = None, track: bool = False):
+        self.label = label
+        self.owner = owner
+        self.counted = 0
+        self.track = track
+
+    def ensure_counted(self, fallback_duration_s: float) -> None:
+        """Guarantee exactly one compile record for this scope: a no-op
+        when the listener already attributed one, else the fallback
+        (call wall time — an upper bound that includes the first
+        execute, honest enough for the histogram's ~33% buckets)."""
+        if not self.counted:
+            record_compile(
+                self.label, fallback_duration_s, owner=self.owner,
+                track=self.track,
+            )
+
+
+class program:
+    """Context manager: attribute XLA compiles in this thread to
+    ``label`` (``with perf.program("materialize"): ...``).  Nests —
+    the innermost scope wins.  Yields the scope object."""
+
+    def __init__(self, label: str, owner: Any = None, track: bool = False):
+        self.scope = _Scope(label, owner, track)
+
+    def __enter__(self) -> _Scope:
+        stack = getattr(_tls, "scopes", None)
+        if stack is None:
+            stack = _tls.scopes = []
+        stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc) -> bool:
+        stack = getattr(_tls, "scopes", None)
+        if stack and stack[-1] is self.scope:
+            stack.pop()
+        elif stack and self.scope in stack:  # tolerate imbalance
+            stack.remove(self.scope)
+        return False
+
+
+def _current_scope() -> Optional[_Scope]:
+    stack = getattr(_tls, "scopes", None)
+    return stack[-1] if stack else None
+
+
+def _on_duration_event(name: str, duration_s: float, **kwargs) -> None:
+    """The jax.monitoring listener: every backend compile lands here,
+    on the compiling thread, and is attributed to that thread's ambient
+    scope (``other`` when none).  Never raises — telemetry must not
+    fail the compile it observes."""
+    try:
+        if name not in _COMPILE_EVENTS:
+            return
+        scope = _current_scope()
+        if scope is not None:
+            scope.counted += 1
+            record_compile(
+                scope.label, duration_s, owner=scope.owner,
+                track=scope.track,
+            )
+        else:
+            record_compile("other", duration_s)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def install_monitoring() -> bool:
+    """Register the compile-duration listener with ``jax.monitoring``
+    (idempotent; False when this JAX has no monitoring API).  Hooked by
+    ``ensure_compilation_cache`` and the serving engine, so either
+    entry point arms the observatory."""
+    global _monitoring
+    if _monitoring:
+        return True
+    with _install_lock:
+        if _monitoring:
+            return True
+        try:
+            from jax import monitoring as _jm
+
+            _jm.register_event_duration_secs_listener(_on_duration_event)
+            _monitoring = True
+        except Exception:  # noqa: BLE001 — no jax / old jax: fallback timing
+            return False
+    return True
+
+
+def monitoring_installed() -> bool:
+    return _monitoring
+
+
+# ---------------------------------------------------------------------------
+# Compile recording + the recompile-storm detector
+
+_storm_lock = threading.Lock()
+# (program, engine_id) -> compiles seen for that exact program identity
+# (tracked calls only).  Recompile semantics live HERE, not on the bare
+# label: one process may hold N engines of different geometries, each
+# legitimately compiling "decode_chunk" once — a recompile is the SAME
+# engine's program compiling again.
+_per_owner_compiles: Dict[Tuple[str, str], int] = {}
+# (program, engine_id) -> deque of recompile timestamps in the window
+_recompiles: Dict[Tuple[str, str], deque] = {}
+# (program, engine_id) latched storms, cleared when the window drains
+_latched: Dict[Tuple[str, str], float] = {}
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_STORM_N = max(2, int(_env_float("TDX_RECOMPILE_STORM_N", 3)))
+_STORM_WINDOW_S = _env_float("TDX_RECOMPILE_STORM_WINDOW_S", 30.0)
+
+
+def storm_config(
+    threshold: Optional[int] = None, window_s: Optional[float] = None
+) -> Tuple[int, float]:
+    """Read (and optionally set — tests) the storm detector's knobs:
+    ``threshold`` recompiles of one program within ``window_s`` seconds
+    latch the storm.  Returns the previous ``(threshold, window_s)``."""
+    global _STORM_N, _STORM_WINDOW_S
+    prev = (_STORM_N, _STORM_WINDOW_S)
+    if threshold is not None:
+        if threshold < 2:
+            raise ValueError("storm threshold must be >= 2")
+        _STORM_N = int(threshold)
+    if window_s is not None:
+        if window_s <= 0:
+            raise ValueError("storm window_s must be > 0")
+        _STORM_WINDOW_S = float(window_s)
+    return prev
+
+
+def record_compile(
+    prog: str, duration_s: float, owner: Any = None, track: bool = False
+) -> None:
+    """Count one compile of ``prog``: the count/time families always;
+    with ``track`` (the label denotes one exact program identity — a
+    :class:`JitProgram` call site), also the per-``(program, owner)``
+    recompile counter past that identity's first compile, and the storm
+    check."""
+    c = _core.counter("compile.count", program=prog)
+    c.add()
+    _core.histogram("compile.time_s", program=prog).observe(
+        max(0.0, float(duration_s))
+    )
+    if track:
+        _note_tracked_compile(prog, owner)
+
+
+def _owner_eid(owner: Any) -> str:
+    return str(getattr(owner, "engine_id", "")) if owner is not None else ""
+
+
+def _note_tracked_compile(prog: str, owner: Any) -> None:
+    eid = _owner_eid(owner)
+    key = (prog, eid)
+    now = time.monotonic()
+    cut = now - _STORM_WINDOW_S
+    with _storm_lock:
+        n = _per_owner_compiles.get(key, 0) + 1
+        _per_owner_compiles[key] = n
+        if n <= 1:
+            return  # this identity's FIRST compile: not a recompile
+        dq = _recompiles.setdefault(key, deque())
+        dq.append(now)
+        while dq and dq[0] < cut:
+            dq.popleft()
+        storming = len(dq) >= _STORM_N
+        fresh = storming and key not in _latched
+        if storming:
+            _latched[key] = now
+    _core.counter("compile.recompiles", program=prog).add()
+    if not fresh:
+        return
+    # Side effects OUTSIDE the lock (flight_dump is file I/O and the
+    # owner hook may take engine-side locks).
+    _T_STORMS.add()
+    if eid:
+        _core.gauge("serve.recompile_storm", engine=eid).set(1)
+    _core.event(
+        "perf.recompile_storm", engine=eid or None, program=prog,
+        n=_STORM_N, window_s=_STORM_WINDOW_S,
+    )
+    _core.flight_dump(
+        "recompile_storm", program=prog, engine=eid or None,
+        n_recompiles=_STORM_N, window_s=_STORM_WINDOW_S,
+        ledger=ledger.components(),
+    )
+    if owner is not None:
+        try:
+            # The stall-watchdog convention: OVERLOADED routes a fleet
+            # around the engine; its own healthy ticks restore READY.
+            owner._mark_stalled()
+        except Exception:  # noqa: BLE001 — observability never fails serving
+            pass
+
+
+def _maybe_unlatch(prog: str, owner: Any) -> None:
+    """Clear a latched storm once ``prog`` has gone a full window with
+    no recompile (called from the wrapped-call fast path — one dict
+    probe when nothing is latched)."""
+    if not _latched:
+        return
+    eid = _owner_eid(owner)
+    key = (prog, eid)
+    with _storm_lock:
+        last = _latched.get(key)
+        if last is None or time.monotonic() - last < _STORM_WINDOW_S:
+            return
+        del _latched[key]
+        # The engine gauge covers EVERY program on the engine: it only
+        # clears when the last of the engine's latched storms drains —
+        # one program going quiet must not mask another still churning.
+        still_latched = any(k[1] == eid for k in _latched)
+    if eid and not still_latched:
+        _core.gauge("serve.recompile_storm", engine=eid).set(0)
+
+
+# ---------------------------------------------------------------------------
+# JitProgram: exact per-program compile detection at the call site
+
+
+def _cache_size(fn: Any) -> Optional[int]:
+    """The jitted callable's executable-cache entry count, or None for
+    anything that is not a live jit wrapper (plain functions, chaos
+    stand-ins) — those pass through uninstrumented."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 — foreign wrapper: pass through
+        return None
+
+
+class JitProgram:
+    """One jitted program under a stable observatory label.
+
+    ``resolve`` is a zero-arg callable returning the CURRENT function —
+    late-bound so a module-global the engine's tests monkeypatch
+    (``engine._decode_chunk``) stays patchable; a stand-in without a
+    jit cache is simply not instrumented.  ``call`` passes everything
+    through and, when the call grew the jit cache, records the compile
+    under ``program`` (per-call override for bucketed variants) against
+    ``owner`` (the engine the storm detector should mark)."""
+
+    __slots__ = ("resolve", "program")
+
+    def __init__(self, resolve: Callable[[], Any], program: str):
+        self.resolve = resolve
+        self.program = program
+
+    def call(
+        self, owner: Any, prog: Optional[str], *args, **kwargs
+    ) -> Any:
+        fn = self.resolve()
+        n0 = _cache_size(fn)
+        if n0 is None:
+            return fn(*args, **kwargs)
+        label = prog or self.program
+        t0 = time.perf_counter()
+        with program(label, owner, track=True) as scope:
+            out = fn(*args, **kwargs)
+        n1 = _cache_size(fn)
+        if n1 is not None and n1 > n0 and not scope.counted:
+            # The cache grew but no compile event landed on THIS thread:
+            # a persistent-cache deserialize (no backend compile), or —
+            # these jit fns are module-global — ANOTHER engine's
+            # concurrent compile bumping the shared cache.  Count the
+            # program load, but feed recompile/storm tracking only when
+            # monitoring is absent entirely: with the listener armed, it
+            # is the exact per-thread source, and attributing a peer's
+            # compile here could storm-latch a healthy engine.
+            record_compile(
+                label, time.perf_counter() - t0, owner=owner,
+                track=not _monitoring,
+            )
+        elif n1 is not None and n1 <= n0 and not scope.counted:
+            _maybe_unlatch(label, owner)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger
+
+
+def pytree_nbytes(tree: Any) -> int:
+    """Total array bytes of a pytree (jax arrays, numpy — anything with
+    ``nbytes``)."""
+    import jax
+
+    return sum(
+        int(getattr(x, "nbytes", 0)) for x in jax.tree.leaves(tree)
+    )
+
+
+class Ledger:
+    """Attribute device bytes to named components.
+
+    ``register(component, nbytes, owner=...)`` sets one owner's share of
+    a component; the exported ``mem.hbm_bytes{component=}`` gauge is the
+    sum over owners, so N engines each registering their ``kv_pool``
+    read as one pool total, while N engines sharing ONE params pytree
+    register ``weights`` under the same owner key and count once.  An
+    owner that goes away (engine close) ``unregister``-s; a component
+    whose last owner leaves is pruned from the registry — bounded
+    cardinality, same rule as the tenant families."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str], int] = {}
+
+    def register(
+        self, component: str, nbytes: int, owner: Any = None
+    ) -> None:
+        key = (str(component), str(owner) if owner is not None else "")
+        with self._lock:
+            # Gauge update INSIDE the lock: a register racing an
+            # unregister (hot swap tearing v1 down while v2 builds)
+            # must not apply its total after the other's prune and
+            # leave a live component missing from /metrics.  (The
+            # registry lock nests under this one and never takes it
+            # back — no ordering cycle.)
+            self._entries[key] = int(nbytes)
+            _core.gauge("mem.hbm_bytes", component=component).set(
+                self._component_total(component)
+            )
+
+    def unregister(self, component: str, owner: Any = None) -> None:
+        key = (str(component), str(owner) if owner is not None else "")
+        with self._lock:
+            self._entries.pop(key, None)
+            total = self._component_total(component)
+            if total:
+                _core.gauge("mem.hbm_bytes", component=component).set(total)
+            else:
+                _core.remove("mem.hbm_bytes", component=component)
+
+    def _component_total(self, component: str) -> int:
+        return sum(
+            v for (c, _), v in self._entries.items() if c == component
+        )
+
+    def components(self) -> Dict[str, int]:
+        """``{component: total bytes}`` — the snapshot OOM dumps carry."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for (c, _), v in self._entries.items():
+                out[c] = out.get(c, 0) + v
+        return out
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._entries.values())
+
+    def _clear(self) -> None:
+        with self._lock:
+            comps = {c for c, _ in self._entries}
+            self._entries.clear()
+        for c in comps:
+            _core.remove("mem.hbm_bytes", component=c)
+
+
+ledger = Ledger()
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+
+
+def is_oom(err: BaseException) -> bool:
+    """True when ``err`` is a device out-of-memory (the
+    RESOURCE_EXHAUSTED family XLA raises)."""
+    msg = f"{type(err).__name__}: {err}"
+    return any(marker in msg for marker in _OOM_MARKERS)
+
+
+def oom_dump(reason: str, *, engine: Optional[str] = None, **attrs) -> int:
+    """The OOM post-mortem moment: count it, emit the event, and dump
+    the flight ring with the HBM ledger snapshot attached — so the
+    record of *what held the memory* survives the failure.  ``reason``
+    is ``"device_oom"`` for a RESOURCE_EXHAUSTED device call and
+    ``"pool_exhausted"`` for a page-pool reservation that could not be
+    met.  Returns the number of flight records dumped."""
+    _T_OOMS.add()
+    components = ledger.components()
+    _core.event(
+        "mem.oom", engine=engine, reason=reason,
+        hbm_bytes=components, **attrs,
+    )
+    return _core.flight_dump(
+        reason, engine=engine, ledger=components,
+        hbm_total_bytes=sum(components.values()), **attrs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Test isolation: telemetry.reset() clears perf state too
+
+
+def _reset() -> None:
+    with _storm_lock:
+        _per_owner_compiles.clear()
+        _recompiles.clear()
+        _latched.clear()
+    ledger._clear()
+
+
+_core.on_reset(_reset)

@@ -51,6 +51,13 @@ def ensure_compilation_cache() -> None:
         if _done:
             return
         _done = True
+        # Arm the compile observatory with the cache: both exist because
+        # compile time dominates materialization cost, and every entry
+        # point that configures one should see the other's metrics
+        # (docs/observability.md, "Perf plane").
+        from ..telemetry import perf as _perf
+
+        _perf.install_monitoring()
         _T_ENABLED.set(0)
         if os.environ.get("TDX_NO_COMPILATION_CACHE"):
             return
