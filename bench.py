@@ -624,7 +624,10 @@ def bench_serving():
         t0 = time.perf_counter()
         i, tick = 0, 0
         n = len(trace_prompts)
-        while i < n or len(eng.scheduler) or eng.stats()["running"]:
+        while (
+            i < n or len(eng.scheduler) or eng.stats()["running"]
+            or eng.audit_backlog()
+        ):
             while i < n and trace_arrival[i] <= tick:
                 eng.submit(
                     trace_prompts[i], max_new_tokens=int(trace_outs[i]), key=i
@@ -841,6 +844,37 @@ def bench_serving():
         3,
     )
 
+    # Audit-overhead phase (ISSUE 14): the SAME headline trace against
+    # an engine shadow-auditing at 100% sampling — every completed
+    # request re-executes once through the same compiled programs when
+    # the queue is quiet.  Paired sustained tok/s plus the wall-clock
+    # multiple; the sustained ratio is the acceptance number
+    # bench_gate's tolerance band holds (auditing reuses the warm
+    # programs, so it also rides the decode-recompile assert below).
+    telemetry.drain()
+    aeng = Engine(
+        params, model=llama, cfg=cfg, num_slots=num_slots,
+        block_size=block_size, num_blocks=num_blocks,
+        max_model_len=max_model_len, decode_chunk=chunk,
+        min_prefill_bucket=32, audit_sample=1.0,
+    )
+    a_wall, _a_peak, a_st = run_trace(aeng, prompts, outs, arrival)
+    assert a_st.get("audit_divergences", 0) == 0, (
+        "shadow audit diverged during the bench — determinism broke"
+    )
+    audit_row = {
+        "audit_sample": 1.0,
+        "wall_s": round(a_wall, 3),
+        "sustained_decode_tokens_per_s": a_st.get("decode_tokens_per_s"),
+        "audit_checked": a_st.get("audit_checked"),
+        "audit_divergences": a_st.get("audit_divergences"),
+        "wall_overhead_x": round(a_wall / wall, 3) if wall else None,
+    }
+    if st.get("decode_tokens_per_s") and a_st.get("decode_tokens_per_s"):
+        audit_row["sustained_ratio"] = round(
+            a_st["decode_tokens_per_s"] / st["decode_tokens_per_s"], 3
+        )
+
     # Perf plane (ISSUE 12): per-program compile counts across the
     # measured phases, the steady-state decode-recompile invariant, and
     # the HBM ledger's component attribution.  The decode chunk was
@@ -900,6 +934,9 @@ def bench_serving():
         },
         "prefix_heavy": prefix,
         "multi_tenant": multi,
+        # Audit plane (ISSUE 14): auditor overhead, sustained tok/s
+        # audit on vs off on the same trace.
+        "audit": audit_row,
         # Perf plane: what compiled (per program) during the measured
         # phases, the asserted steady-state invariant, and where the
         # device bytes sit (the HBM ledger's component attribution).

@@ -63,6 +63,13 @@ METRICS: List[Tuple[str, Tuple[str, ...], bool, float]] = [
     ("serving_tpot_p95_s", _SERVING + ("tpot_p95_s",), False, 0.35),
     ("serving_goodput_tok_s",
      _SERVING + ("goodput_tokens_per_s",), True, 0.20),
+    # Audit plane (ISSUE 14): sustained tok/s with the shadow auditor
+    # at 100% sampling over sustained tok/s without it, same trace.  A
+    # ratio collapse means auditing stopped being shadow traffic
+    # (preempting/queueing ahead of user work, or recompiling).  Gates
+    # vacuously (no_baseline) until a round records it.
+    ("serving_audit_sustained_ratio",
+     _SERVING + ("audit", "sustained_ratio"), True, 0.25),
 ]
 
 
@@ -162,7 +169,9 @@ def run_fast() -> Dict[str, Any]:
     is present (CI: the virtual CPU mesh), reporting the same serving
     metric names the headline bench feeds the gate — plus the compile
     observatory's per-program counts, the steady-state decode-recompile
-    invariant, and the HBM ledger rows."""
+    invariant (asserted WITH the shadow auditor at 100% sampling: audit
+    replays must reuse the same compiled geometries), the audit
+    on/off sustained ratio, and the HBM ledger rows."""
     sys.path.insert(0, REPO)
     import jax
 
@@ -202,20 +211,35 @@ def run_fast() -> Dict[str, Any]:
     warm.drain()
     warm.close()
 
-    c0 = telemetry.counters()
-    eng = make_engine()
     import time
 
-    t0 = time.perf_counter()
-    i = tick = 0
-    while i < n_req or len(eng.scheduler) or eng.stats()["running"]:
-        while i < n_req and arrival[i] <= tick:
-            eng.submit(prompts[i], max_new_tokens=int(outs[i]), key=i)
-            i += 1
-        eng.step()
-        tick += 1
-    wall = time.perf_counter() - t0
-    st = eng.stats()
+    def run_trace(eng):
+        t0 = time.perf_counter()
+        i = tick = 0
+        while (
+            i < n_req or len(eng.scheduler) or eng.stats()["running"]
+            or eng.audit_backlog()
+        ):
+            while i < n_req and arrival[i] <= tick:
+                eng.submit(prompts[i], max_new_tokens=int(outs[i]), key=i)
+                i += 1
+            eng.step()
+            tick += 1
+        return time.perf_counter() - t0, eng.stats()
+
+    c0 = telemetry.counters()
+    eng = make_engine()
+    wall, st = run_trace(eng)
+    # The same trace with the shadow auditor at 100% sampling: the
+    # decode-recompile invariant below covers this run too — audit
+    # replays must compile NOTHING new — and the sustained ratio is
+    # the audit-overhead acceptance number.
+    aeng = Engine(
+        params, model=llama, cfg=cfg, num_slots=4, block_size=8,
+        num_blocks=41, max_model_len=64, decode_chunk=4,
+        handle_preemption=False, audit_sample=1.0,
+    )
+    _a_wall, a_st = run_trace(aeng)
     c1 = telemetry.counters()
 
     compile_counts = {
@@ -232,6 +256,17 @@ def run_fast() -> Dict[str, Any]:
         if k.startswith("mem.hbm_bytes")
     }
     eng.close()
+    audit_row = {
+        "audit_sample": 1.0,
+        "sustained_decode_tokens_per_s": a_st.get("decode_tokens_per_s"),
+        "audit_checked": a_st.get("audit_checked"),
+        "audit_divergences": a_st.get("audit_divergences"),
+    }
+    if st.get("decode_tokens_per_s") and a_st.get("decode_tokens_per_s"):
+        audit_row["sustained_ratio"] = round(
+            a_st["decode_tokens_per_s"] / st["decode_tokens_per_s"], 3
+        )
+    aeng.close()
     return {
         "details": {
             "serving_llama_350m_continuous": {
@@ -248,6 +283,7 @@ def run_fast() -> Dict[str, Any]:
                 "compile_counts": compile_counts,
                 "decode_recompiles_steady": decode_recompiles,
                 "hbm_bytes": hbm,
+                "audit": audit_row,
             }
         },
         "fast": True,
@@ -299,12 +335,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if fast["decode_recompiles_steady"] != 0:
             invariant_failures.append(
                 "steady-state decode recompiles = "
-                f"{fast['decode_recompiles_steady']} (must be 0: the "
-                "decode chunk compiled again after warm-up — shape leak)"
+                f"{fast['decode_recompiles_steady']} (must be 0 — WITH "
+                "auditing enabled: the decode chunk compiled again after "
+                "warm-up, a shape leak in the serving or audit path)"
             )
         if not fast["hbm_bytes"]:
             invariant_failures.append(
                 "HBM ledger empty: mem.hbm_bytes{component=} rows missing"
+            )
+        audit = fast.get("audit") or {}
+        if not audit.get("audit_checked"):
+            invariant_failures.append(
+                "shadow auditor checked nothing in the audited fast round"
+            )
+        if audit.get("audit_divergences"):
+            invariant_failures.append(
+                f"audit.divergences = {audit['audit_divergences']} in the "
+                "fast round — determinism broke under audit replay"
             )
     elif args.candidate:
         candidate = load_bench(args.candidate)

@@ -72,6 +72,15 @@ its own deadline/cancel — zero requests lost to infrastructure — with
 zero leaked pages on every replica, and the exported trace must show
 the ``fleet.swap`` span and ``fleet.failovers >= 1``.
 
+**Audit plane** (ISSUE 14): with ``TDX_AUDIT_SAMPLE`` set (CI runs
+both modes at 1.0), every soak engine shadow-audits its completed
+requests — re-executing them through the same programs and comparing
+determinism digests.  The drive loops wait out the audit backlog, and
+the final trace assertion gates ``audit.checked >= 1`` AND
+``audit.divergences == 0``: a soak whose faults, preemptions,
+failovers, and swaps all replay token-identically must ALSO re-execute
+divergence-free at 100% sampling.
+
 CI (.github/workflows/ci.yaml, chaos-soak + fleet-chaos jobs) runs both
 modes with ``TDX_TELEMETRY`` set.  Locally:
 
@@ -91,7 +100,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 EOS = 5
 SEED = int(os.environ.get("TDX_CHAOS_SEED", "5"))
 N_REQUESTS = int(os.environ.get("TDX_CHAOS_REQUESTS", "200"))
-MAX_STEPS = 60 * N_REQUESTS
+# 100% audit sampling roughly doubles the work per wave (every request
+# re-executes once); the hang bound scales with it.  Parsed as a float:
+# an explicit TDX_AUDIT_SAMPLE=0 means auditing OFF, not "gate on it".
+try:
+    AUDITING = float(os.environ.get("TDX_AUDIT_SAMPLE") or 0) > 0
+except ValueError:
+    AUDITING = True  # malformed: let Engine() raise the real error
+MAX_STEPS = 60 * N_REQUESTS * (2 if AUDITING else 1)
 
 
 def fail(msg: str) -> int:
@@ -304,7 +320,7 @@ def main() -> int:
         reqs.append((prompt, mnt, i, h))
 
     for tick in range(MAX_STEPS):
-        if not (len(eng.scheduler) or eng._n_running()):
+        if not (len(eng.scheduler) or eng._n_running() or eng.audit_backlog()):
             break
         eng.step()
         if tick % 25 == 10:
@@ -389,7 +405,7 @@ def main() -> int:
         preqs.append((prompt, mnt, 2000 + i, h))
 
     for tick in range(MAX_STEPS):
-        if not (len(engp.scheduler) or engp._n_running()):
+        if not (len(engp.scheduler) or engp._n_running() or engp.audit_backlog()):
             break
         engp.step()
         if tick % 25 == 10:
@@ -504,7 +520,7 @@ def main() -> int:
         qreqs.append((prompt, mnt, 3100 + i, h))
 
     for tick in range(MAX_STEPS):
-        if not (len(engq.scheduler) or engq._n_running()):
+        if not (len(engq.scheduler) or engq._n_running() or engq.audit_backlog()):
             break
         engq.step()
         if tick % 25 == 10:
@@ -573,10 +589,15 @@ def main() -> int:
     # chunk would finish the request in one tick, leaving nothing
     # pending — and stillness without pending work is (correctly) not a
     # stall.  The 24-token budget guarantees in-flight work to wedge.
+    # audit_sample pinned OFF here: this phase deliberately stops the
+    # tick loop, and a shadow audit admitted right before the
+    # latch-clear wait would re-trip the (tight) stall deadline while
+    # the driver is polling the gauge instead of stepping.  Audit
+    # coverage comes from phases 1/1.5/1.6.
     engw = Engine(
         params, model=llama, cfg=cfg, num_slots=4,
         block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
-        drain_deadline_s=120.0, ops_port=0,
+        drain_deadline_s=120.0, ops_port=0, audit_sample=0.0,
         ops_config=tdx_ops.OpsConfig(
             stall_deadline_s=0.5, watchdog_poll_s=0.05
         ),
@@ -723,6 +744,22 @@ def main() -> int:
         f"scrapes, stalls={counters.get('serve.stalls')}, "
         f"scrape_count={counters.get('ops.scrapes')}"
     )
+    if AUDITING:
+        if counters.get("audit.checked", 0) < 1:
+            return fail(
+                "TDX_AUDIT_SAMPLE set but the trace shows no audit.checked"
+            )
+        if counters.get("audit.divergences", 0) != 0:
+            return fail(
+                f"audit.divergences = {counters.get('audit.divergences')} "
+                "!= 0 — the shadow auditor caught a non-token-identical "
+                "replay (see the reason=divergence flight dump)"
+            )
+        print(
+            f"chaos_soak: audit OK — checked={counters.get('audit.checked')}"
+            f", divergences=0, dropped={counters.get('audit.dropped', 0)}, "
+            f"aborted={counters.get('audit.aborted', 0)}"
+        )
     missing = {"serve.recover", "serve.drain", "serve.prefill", "serve.step"} - spans
     if missing:
         return fail(f"trace missing spans {missing}")
@@ -893,6 +930,22 @@ def fleet_main() -> int:
                         "generate()"
                     )
                 n_ok += 1
+        # Shadow audits hold pages while they run like any request:
+        # wait the surviving replicas' audit backlogs out before the
+        # leak accounting (bounded — a stuck audit is a hang).
+        for _ in range(MAX_STEPS):
+            live = [
+                rep.engine for rep in router.replicas()
+                if rep.engine.health() is not Health.STOPPED
+            ]
+            if not any(
+                len(e.scheduler) or e._n_running() or e.audit_backlog()
+                for e in live
+            ):
+                break
+            router.step()
+        else:
+            return f"[{label}] audit backlog did not drain (hang)"
         for name, eng in (
             ("A", eng_a), ("B", eng_b), ("C", eng_c["eng"]),
         ):
@@ -954,11 +1007,15 @@ def fleet_main() -> int:
     # OVERLOADED, and ROUTED AROUND — then rejoin once it recovers.
     def make_wedge_engine():
         # No EOS: an early EOS could finish the wedge stream in one
-        # tick, leaving nothing pending to stall on.
+        # tick, leaving nothing pending to stall on.  audit_sample
+        # pinned OFF (as in the engine wedge phase): pending shadow
+        # audits must not blur what "no progress with work pending"
+        # means while the driver deliberately stops stepping.
         return Engine(
             params, model=llama, cfg=cfg, num_slots=4, block_size=8,
             num_blocks=33, max_model_len=64, decode_chunk=4,
             drain_deadline_s=120.0, handle_preemption=False,
+            audit_sample=0.0,
         )
 
     eng_a = make_wedge_engine()
@@ -1029,6 +1086,21 @@ def fleet_main() -> int:
         return fail("trace shows no serve.stalls from the fleet wedge")
     if os.environ.get("TDX_FLIGHT_RECORDER") and "stall" not in dumps:
         return fail(f"trace shows no reason=stall dump (dumps: {dumps})")
+    if AUDITING:
+        if counters.get("audit.checked", 0) < 1:
+            return fail(
+                "TDX_AUDIT_SAMPLE set but the fleet trace shows no "
+                "audit.checked"
+            )
+        if counters.get("audit.divergences", 0) != 0:
+            return fail(
+                f"audit.divergences = {counters.get('audit.divergences')} "
+                "!= 0 in the fleet soak"
+            )
+        print(
+            "chaos_soak: fleet audit OK — "
+            f"checked={counters.get('audit.checked')}, divergences=0"
+        )
     missing = {"fleet.swap", "serve.drain", "serve.prefill"} - spans
     if missing:
         return fail(f"trace missing spans {missing}")

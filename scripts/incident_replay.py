@@ -1,0 +1,509 @@
+#!/usr/bin/env python
+"""Incident replay: turn a flight-dump JSONL into a runnable repro.
+
+Every flight dump the ops plane already produces — ``stall``,
+``recompile_storm``, ``device_oom``, ``pool_exhausted``, ``slo_burn``,
+``divergence`` — carries the recent event window: ``req.submitted``
+events with each traced request's **replay identity** (prompt token
+ids + normalized sampling key), ``req.admitted`` admission order,
+``req.finished`` events with the per-request **determinism digest**
+(docs/observability.md, "Audit plane"), the ``serve.engine_config``
+geometry event, and ``fault.fired`` markers for any injected faults.
+This tool closes the loop:
+
+1. **Reconstruct** the request set from the dump (prompt, key,
+   max_new_tokens, tenant/priority, admission order) and the engine
+   geometry from ``serve.engine_config``.
+2. **Re-run** it against a fresh engine (weights from ``--model`` —
+   bytes don't live in traces), sequentially in admission order.
+   Engine output is token-identical to solo ``generate()`` and
+   batch-order invariant, so the sequential re-run IS the
+   deterministic ground truth for every request.
+3. **Bisect**: any request whose recorded digest differs from its
+   re-run digest is a reproduced divergence.  When the dump carries
+   the incident's token streams (a ``reason="divergence"`` dump from
+   the shadow auditor always does), the first diverging token maps to
+   the exact chunk that committed it (token 0 = the prefill's
+   first-token sample = chunk 0; decode chunk j commits tokens
+   ``1+(j-1)*decode_chunk .. j*decode_chunk``).
+4. Optionally (``--with-faults``) re-arm the dump's ``fault.fired``
+   schedule and re-run again: for a single-stream incident the faulted
+   re-run must reproduce the recorded digests exactly — the incident
+   is now a deterministic, replayable artifact.
+
+Exit codes: ``0`` — analysis completed (divergences, if any recorded,
+were reproduced and bisected); ``1`` — the dump records a divergence
+this replay could NOT reproduce, or a ``--with-faults`` reproduction
+failed; ``2`` — nothing replayable in the dump (no traced requests
+with replay identities, or no parsable records).
+
+Usage::
+
+    python scripts/incident_replay.py /path/flight.jsonl
+    python scripts/incident_replay.py flight.jsonl --with-faults --json out.json
+    python scripts/incident_replay.py --drill        # CI: end-to-end
+        # corrupt-fault incident drill — seeds a corrupt fault under
+        # load at 100% audit sampling, asserts the auditor flight-dumps
+        # the divergence, then replays its own dump and asserts the
+        # bisection lands on the faulted chunk.
+
+``--model`` selects the weights: ``llama-test`` (the CI/chaos tiny
+llama, default) or ``module.path:factory`` returning
+``(params, model_module, cfg)``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+__all__ = ["analyze", "load_dump", "main"]
+
+
+# ---------------------------------------------------------------------------
+# Dump parsing
+
+
+def load_dump(path: str) -> List[Dict[str, Any]]:
+    """All parsable JSONL records in the dump (bad lines skipped — a
+    truncated tail must not void the post-mortem)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+def _attrs(rec: Dict[str, Any]) -> Dict[str, Any]:
+    return rec.get("attrs") or {}
+
+
+def parse_incident(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The incident's structure: requests (with replay identity and
+    recorded digests), engine config, fault schedule, divergence dumps."""
+    requests: Dict[str, Dict[str, Any]] = {}
+    config: Dict[str, Any] = {}
+    faults_fired: List[Dict[str, Any]] = []
+    divergence_dumps: List[Dict[str, Any]] = []
+    dump_reasons: List[str] = []
+    for rec in records:
+        rtype = rec.get("type")
+        if rtype == "flight_dump":
+            dump_reasons.append(rec.get("reason"))
+            if rec.get("reason") == "divergence":
+                divergence_dumps.append(_attrs(rec))
+            continue
+        if rtype != "event":
+            continue
+        name = rec.get("name")
+        attrs = _attrs(rec)
+        if name == "serve.engine_config":
+            config = dict(attrs)
+            config["engine"] = rec.get("engine")
+            continue
+        if name == "fault.fired":
+            faults_fired.append(dict(attrs))
+            continue
+        rid = rec.get("rid")
+        if rid is None:
+            continue
+        req = requests.setdefault(rid, {"rid": rid})
+        if name == "req.submitted":
+            # Engine-level re-submissions repeat req.submitted per hop;
+            # the replay identity (prompt/key) is identical on each —
+            # first one with a prompt wins.  Audit replays are marked
+            # and excluded from the re-run (the re-run IS the audit).
+            if "prompt" in attrs and "prompt" not in req:
+                req["prompt"] = attrs["prompt"]
+                req["key"] = attrs.get("key")
+                req["max_new"] = attrs.get("max_new")
+                req["tenant"] = attrs.get("tenant", "default")
+                req["priority"] = attrs.get("priority", 0)
+            if attrs.get("audit_of") is not None:
+                req["audit_of"] = attrs["audit_of"]
+            req.setdefault("submitted_ts", rec.get("ts"))
+        elif name == "req.admitted":
+            req.setdefault("admitted_ts", rec.get("ts"))
+        elif name == "req.finished":
+            req["digest"] = attrs.get("digest")
+            req["n_tokens"] = attrs.get("n_tokens")
+        elif name == "req.failed":
+            req["failed"] = attrs.get("error")
+    return {
+        "requests": requests,
+        "config": config,
+        "faults_fired": faults_fired,
+        "divergence_dumps": divergence_dumps,
+        "dump_reasons": dump_reasons,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Model factories
+
+
+def _model_llama_test():
+    import jax
+
+    from torchdistx_tpu.models import llama
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return params, llama, cfg
+
+
+def resolve_model(spec: str):
+    """``llama-test`` or ``module.path:factory`` →
+    ``(params, model_module, cfg)``."""
+    if spec == "llama-test":
+        return _model_llama_test()
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise SystemExit(
+            f"--model {spec!r}: expected 'llama-test' or 'module:factory'"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), fn_name)()
+
+
+# ---------------------------------------------------------------------------
+# The replay
+
+
+def _build_engine(config: Dict[str, Any], params, model, cfg, **overrides):
+    from torchdistx_tpu.serving import Engine
+
+    kw = dict(
+        num_slots=config.get("num_slots", 4),
+        block_size=config.get("block_size", 8),
+        num_blocks=config.get("num_blocks"),
+        max_model_len=config.get("max_model_len"),
+        temperature=config.get("temperature", 0.0),
+        top_k=config.get("top_k"),
+        eos_id=config.get("eos_id"),
+        decode_chunk=config.get("decode_chunk", 8),
+        prefill_chunk=config.get("prefill_chunk", 512),
+        max_prefills_per_tick=config.get("max_prefills_per_tick", 1),
+        scheduler=config.get("scheduler", "fifo"),
+        model_version=config.get("model_version", "v0"),
+        handle_preemption=False,
+    )
+    kw.update(overrides)
+    return Engine(params, model=model, cfg=cfg, **kw)
+
+
+def analyze(
+    records: List[Dict[str, Any]],
+    *,
+    model: str = "llama-test",
+    with_faults: bool = False,
+    max_requests: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Re-run a parsed dump against a fresh engine and bisect any
+    divergence (importable — the drill and the tests call this)."""
+    import numpy as np
+
+    from torchdistx_tpu.resilience import faults as faults_mod
+    from torchdistx_tpu.serving import RequestError
+    from torchdistx_tpu.telemetry import audit
+
+    incident = parse_incident(records)
+    config = incident["config"]
+    decode_chunk = int(config.get("decode_chunk", 8) or 8)
+
+    # The replayable set: traced user requests (not audit replays) that
+    # carried a replay identity and were admitted, in admission order.
+    replayable = sorted(
+        (
+            r for r in incident["requests"].values()
+            if "prompt" in r and "audit_of" not in r
+            and r.get("admitted_ts") is not None
+        ),
+        key=lambda r: (r["admitted_ts"], r.get("submitted_ts") or 0),
+    )
+    if max_requests is not None:
+        replayable = replayable[:max_requests]
+    result: Dict[str, Any] = {
+        "dump_reasons": incident["dump_reasons"],
+        "n_requests_in_dump": len(incident["requests"]),
+        "n_replayable": len(replayable),
+        "engine_config": config,
+        "faults_fired": incident["faults_fired"],
+        "divergences": [],
+        "reproduced": False,
+    }
+    if not replayable:
+        result["error"] = (
+            "nothing replayable: no traced request in the dump carries a "
+            "replay identity (prompt/key on req.submitted)"
+        )
+        return result
+
+    params, model_mod, cfg = resolve_model(model)
+
+    def run_all(engine):
+        """Sequential ground-truth re-run: one request at a time, in
+        admission order (token identity is batch-invariant, so this is
+        a valid oracle for any original interleaving)."""
+        out = {}
+        for r in replayable:
+            key = np.asarray(r["key"], np.uint32)
+            try:
+                h = engine.submit(
+                    np.asarray(r["prompt"], np.int32),
+                    max_new_tokens=int(r["max_new"]),
+                    key=key,
+                )
+                toks = h.result()
+            except (RequestError, ValueError) as err:
+                out[r["rid"]] = {"error": f"{type(err).__name__}: {err}"}
+                continue
+            out[r["rid"]] = {"tokens": toks, "digest": h.digest}
+        return out
+
+    # Pass 1: clean ground truth (no faults, no auditor).
+    eng = _build_engine(config, params, model_mod, cfg, audit_sample=0.0)
+    try:
+        truth = run_all(eng)
+    finally:
+        eng.close()
+
+    # Incident token streams, where the dump carries them (divergence
+    # dumps always do: expected_tokens is the ORIGINAL stream).
+    incident_streams = {
+        d.get("rid"): d.get("expected_tokens")
+        for d in incident["divergence_dumps"]
+        if d.get("rid") is not None
+    }
+
+    recorded_mismatch = False
+    for r in replayable:
+        rid = r["rid"]
+        rerun = truth.get(rid, {})
+        recorded_digest = r.get("digest")
+        if recorded_digest is None or "digest" not in rerun:
+            continue
+        if rerun["digest"] == recorded_digest:
+            continue
+        recorded_mismatch = True
+        row = {
+            "rid": rid,
+            "recorded_digest": recorded_digest,
+            "rerun_digest": rerun["digest"],
+        }
+        stream = incident_streams.get(rid)
+        if stream is not None:
+            idx = audit.first_divergence(stream, rerun["tokens"])
+            row["first_diverging_token"] = idx
+            row["first_diverging_chunk"] = audit.token_chunk(
+                idx, decode_chunk
+            )
+            row["incident_token"] = (
+                int(stream[idx]) if idx < len(stream) else None
+            )
+            row["true_token"] = (
+                int(rerun["tokens"][idx])
+                if idx < len(rerun["tokens"])
+                else None
+            )
+        result["divergences"].append(row)
+
+    # The dump RECORDED a divergence iff an auditor dumped one; the
+    # replay reproduces it iff the clean re-run disagrees with the
+    # recorded digests the same way.
+    recorded_divergence = bool(incident["divergence_dumps"])
+    result["recorded_divergence"] = recorded_divergence
+    result["reproduced"] = (
+        recorded_mismatch if recorded_divergence else not recorded_mismatch
+    )
+
+    # Pass 2 (opt-in): re-arm the incident's fault schedule and re-run —
+    # the faulted engine must reproduce the RECORDED digests, proving
+    # the dump is a complete deterministic repro.  Only meaningful when
+    # fault step numbers align (single-stream incidents; the drill).
+    if with_faults and incident["faults_fired"]:
+        spec = ",".join(
+            f"{f['site']}:{f['step']}:{f['kind']}"
+            for f in incident["faults_fired"]
+            if f.get("kind") not in ("crash", "sigterm", "fatal")
+        )
+        faults_mod.reset(spec or "")
+        eng2 = _build_engine(config, params, model_mod, cfg, audit_sample=0.0)
+        try:
+            faulted = run_all(eng2)
+        finally:
+            eng2.close()
+            faults_mod.reset("")
+        repro = all(
+            faulted.get(r["rid"], {}).get("digest") == r.get("digest")
+            for r in replayable
+            if r.get("digest") is not None
+        )
+        result["faulted_rerun_matches_incident"] = repro
+        if not repro:
+            result["reproduced"] = False
+    return result
+
+
+# ---------------------------------------------------------------------------
+# The CI drill
+
+
+def drill() -> int:
+    """End-to-end incident drill: a seeded ``corrupt`` fault under load
+    at 100% audit sampling must produce a ``reason="divergence"``
+    flight dump naming exactly one stream, and replaying that dump must
+    bisect the divergence to the exact faulted chunk."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.resilience import faults as faults_mod
+    from torchdistx_tpu.serving import Engine
+
+    params, model_mod, cfg = _model_llama_test()
+    flight_path = os.path.join(
+        tempfile.mkdtemp(prefix="tdx-incident-"), "flight.jsonl"
+    )
+    prev = telemetry.configure(flight=flight_path, flight_capacity=8192)
+    # The faulted decode chunk: deep enough that every stream below is
+    # decoding when it fires (all prompts admit within the first ticks).
+    fault_chunk = 6
+    faults_mod.reset(f"serve.step:{fault_chunk}:corrupt")
+    rng = np.random.default_rng(7)
+    try:
+        eng = Engine(
+            params, model=model_mod, cfg=cfg, num_slots=4, block_size=8,
+            num_blocks=41, max_model_len=64, decode_chunk=4,
+            max_prefills_per_tick=4,
+            handle_preemption=False, audit_sample=1.0,
+        )
+        handles = [
+            eng.submit(
+                rng.integers(0, cfg.vocab_size, size=6).astype(np.int32),
+                max_new_tokens=24,
+                key=i,
+            )
+            for i in range(4)
+        ]
+        eng.drain()  # user streams AND their shadow audits
+        for h in handles:
+            assert h.error is None, f"drill request failed: {h.error!r}"
+        st = eng.stats()
+        assert st["audit_checked"] >= 4, st
+        assert st["audit_divergences"] == 1, (
+            "the auditor must flag EXACTLY the corrupted stream, "
+            f"got {st['audit_divergences']}"
+        )
+        detail = eng._auditor.divergence_detail[0]
+        eng.close()
+        faults_mod.reset("")
+
+        records = load_dump(flight_path)
+        assert any(
+            r.get("type") == "flight_dump" and r.get("reason") == "divergence"
+            for r in records
+        ), "no reason=divergence flight dump in the ring"
+        result = analyze(records, with_faults=True)
+        assert result["reproduced"], result
+        assert result["faulted_rerun_matches_incident"], result
+        assert len(result["divergences"]) == 1, result
+        row = result["divergences"][0]
+        assert row["rid"] == detail["rid"], (row, detail)
+        # Independent cross-check: the auditor's own bisection (incident
+        # stream vs its clean shadow replay) and the dump replay's
+        # bisection (incident stream vs the fresh ground-truth re-run)
+        # must land on the same token and chunk.
+        assert row["first_diverging_token"] == detail["first_diverging_token"]
+        assert row["first_diverging_chunk"] == detail["first_diverging_chunk"]
+        print(
+            "incident_replay drill OK — corrupt fault at decode chunk "
+            f"{fault_chunk} caught by the auditor "
+            f"(checked={st['audit_checked']}, divergences=1), dump "
+            f"replayed, bisected to request {row['rid']} token "
+            f"{row['first_diverging_token']} chunk "
+            f"{row['first_diverging_chunk']}, faulted re-run reproduced "
+            "the incident digests"
+        )
+        return 0
+    finally:
+        faults_mod.reset("")
+        telemetry.configure(**prev)
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("dump", nargs="?", help="flight-dump JSONL to replay")
+    ap.add_argument(
+        "--model", default="llama-test",
+        help="weights source: 'llama-test' or module.path:factory "
+        "returning (params, model_module, cfg)",
+    )
+    ap.add_argument(
+        "--with-faults", action="store_true",
+        help="also re-run with the dump's fault.fired schedule re-armed "
+        "and require the recorded digests to reproduce",
+    )
+    ap.add_argument(
+        "--max-requests", type=int, default=None,
+        help="replay at most N requests (admission order)",
+    )
+    ap.add_argument("--json", help="write the analysis JSON here")
+    ap.add_argument(
+        "--drill", action="store_true",
+        help="run the self-contained corrupt-fault incident drill "
+        "(CI acceptance gate); ignores the other arguments",
+    )
+    args = ap.parse_args(argv)
+
+    if args.drill:
+        return drill()
+    if not args.dump:
+        ap.error("a dump path (or --drill) is required")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    records = load_dump(args.dump)
+    if not records:
+        print(f"incident_replay: no parsable records in {args.dump}",
+              file=sys.stderr)
+        return 2
+    result = analyze(
+        records,
+        model=args.model,
+        with_faults=args.with_faults,
+        max_requests=args.max_requests,
+    )
+    out = json.dumps(result, indent=2, sort_keys=True, default=str)
+    print(out)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+    if result.get("error"):
+        return 2
+    return 0 if result["reproduced"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -145,6 +145,19 @@ class RequestTimeline:
                 return None if t is None else float(t)
         return None
 
+    @property
+    def digest(self) -> Optional[str]:
+        """The request's determinism digest (docs/observability.md,
+        "Audit plane"): the full-stream snapshot from req.finished when
+        the request completed, else the admitted-identity snapshot from
+        req.first_token."""
+        for ev in reversed(self._sorted()):
+            if ev["name"] in ("req.finished", "req.first_token"):
+                d = (ev.get("attrs") or {}).get("digest")
+                if d is not None:
+                    return str(d)
+        return None
+
     def phases(self) -> Dict[str, float]:
         """Wall-clock per phase, summing to the request's total.
 
@@ -201,6 +214,7 @@ class RequestTimeline:
             "n_spans": len(self.spans),
             "n_tokens": self.n_tokens,
             "ttft_s": self.ttft_s,
+            "digest": self.digest,
             "phases": {k: round(v, 6) for k, v in ph.items()},
         }
 

@@ -25,13 +25,23 @@ process would clobber.
 **Failover token parity**: engine output is token-identical to solo
 ``generate()`` with the same key, so a replay on a peer reproduces the
 stream from the start.  The fleet handle pins the request key at
-submission, skips the already-yielded prefix of the replacement stream
-(verifying it token-by-token — a divergence fails typed as
-:class:`FailoverDiverged`, never silently), and the consumer's iterator
-continues mid-stream as if nothing happened.  A stream that has already
-yielded tokens is version-pinned: it may only fail over to a replica
-serving the SAME weights version, so tokens from two model versions
-never interleave within one stream (see :mod:`.hot_swap`).
+submission, skips the already-yielded prefix of the replacement stream,
+and the consumer's iterator continues mid-stream as if nothing
+happened.  The prefix is verified against the handle's rolling
+**determinism digest** (:class:`torchdistx_tpu.telemetry.audit
+.DeterminismDigest`): the replayed prefix re-hashes into one digest
+and ONE compare at the skip point decides.  The serving engine's
+``model_version`` folds into every token of the digest, so a
+deliberately version-mixed replay is rejected even when the token ids
+happen to agree; a plain token mismatch additionally short-circuits at
+the first wrong token (the committed list ``result()`` retains anyway
+doubles as an early exit, so a broken replay never decodes a long
+prefix to its end).  Any mismatch fails typed as
+:class:`FailoverDiverged`, never silently.  A stream that has already
+yielded tokens is also version-pinned at routing time: it may only
+fail over to a replica serving the SAME weights version, so tokens
+from two model versions never interleave within one stream (see
+:mod:`.hot_swap`).
 
 **Replica supervision**: a crashed or :meth:`close`-d replica is
 detected via its health state; :meth:`FleetRouter.poll` (called by every
@@ -58,6 +68,7 @@ import numpy as np
 
 from .. import telemetry as _telemetry
 from ..resilience.retry import RetryPolicy
+from ..telemetry import audit as _audit
 from ..telemetry import ops as _ops
 from ..serving.lifecycle import (
     DeadlineExceeded,
@@ -183,10 +194,26 @@ class FleetHandle:
         # Trace context: minted at first bind (lazily — only once
         # something is recording) and forwarded on every hop.
         self.trace_id: Optional[str] = None
+        # Determinism digest over the YIELDED stream (audit plane):
+        # seeded lazily from the first bound engine's normalized key
+        # (every engine normalizes identically, so any bind works),
+        # updated per yielded token with the serving engine's
+        # model_version.  Failover prefix verification compares ONE
+        # digest instead of walking the committed list.
+        self._digest = None
+        self._model_version: str = "v0"
 
     @property
     def done(self) -> bool:
         return self._done
+
+    @property
+    def digest(self) -> Optional[str]:
+        """Hex snapshot of the determinism digest over the tokens this
+        handle has YIELDED (docs/observability.md, "Audit plane");
+        None before the first token.  Equal to the serving engine's
+        request digest for a stream that never failed over."""
+        return None if self._digest is None else self._digest.hexdigest()
 
     def cancel(self) -> bool:
         """Request cancellation (forwarded to the bound engine).  A
@@ -343,6 +370,17 @@ class FleetHandle:
                 continue
             self.replica_id = rep.rid
             self.version = rep.version
+            self._model_version = getattr(rep.engine, "model_version", "v0")
+            if self._digest is None:
+                # Seed from the engine-normalized key so the fleet's
+                # digest and the engine's request digests hash the same
+                # bytes for the same submit(key=...).
+                req = getattr(self._inner, "_req", None)
+                self._digest = _audit.DeterminismDigest(
+                    self._prompt,
+                    req.key if req is not None
+                    else _audit.canonical_key(self._key),
+                )
             if cause is not None:
                 _T_FAILOVERS.add()
                 added = time.perf_counter() - t_fail
@@ -393,6 +431,25 @@ class FleetHandle:
                 self._bind(cause=inner_err)
                 continue
             n_skip = len(self._committed)
+            # Digest-based prefix verification (audit plane): the
+            # replayed prefix re-hashes into a fresh digest and ONE
+            # compare at the skip point decides — the digest, not the
+            # token list, is the verification contract, and because
+            # model_version folds into every token a same-router-tag
+            # peer serving differently-tagged weights is rejected even
+            # when the token ids match.  The per-token compare against
+            # _committed (which result() retains anyway) is an early
+            # exit: a token mismatch cancels the replay at the first
+            # wrong token — with its exact index — instead of decoding
+            # the rest of a long prefix on a broken stream.
+            verify = None
+            if n_skip:
+                req = getattr(inner, "_req", None)
+                verify = _audit.DeterminismDigest(
+                    self._prompt,
+                    req.key if req is not None
+                    else _audit.canonical_key(self._key),
+                )
             i = 0
             try:
                 for tok in inner.tokens():
@@ -408,7 +465,25 @@ class FleetHandle:
                             )
                             self._fail(err)
                             raise err
+                        verify.update((tok,), self._model_version)
+                        if (
+                            i == n_skip
+                            and verify.hexdigest() != self._digest.hexdigest()
+                        ):
+                            inner.cancel()
+                            err = FailoverDiverged(
+                                "failover replay prefix matches token-wise "
+                                "but its determinism digest does not — a "
+                                "version-mixed stream: digest "
+                                f"{verify.hexdigest()} != committed "
+                                f"{self._digest.hexdigest()} (replica "
+                                f"{self.replica_id}, version {self.version}, "
+                                f"model_version {self._model_version})"
+                            )
+                            self._fail(err)
+                            raise err
                         continue
+                    self._digest.update((tok,), self._model_version)
                     self._committed.append(tok)
                     yield tok
                 if i < n_skip:

@@ -51,10 +51,22 @@ Kinds (what happens):
   ``_tdx_nan`` batch key understood by ``make_train_step``) so the
   jit-side non-finite guard trips; at ``serve.step`` the serving engine
   treats the decode chunk as poisoned and skips it.
+* ``corrupt`` — needs caller cooperation (returned, not raised).  At
+  ``serve.step`` the engine runs the decode chunk normally, then flips
+  ONE committed token (first decoding slot, first token of the chunk,
+  XOR 1) on the host — a **silent** determinism break: nothing raises,
+  nothing retries, the stream stays plausible.  The only thing that
+  can catch it is the audit plane (the shadow auditor's digest
+  comparison — docs/observability.md, "Audit plane"), which is exactly
+  what this kind exists to prove.  At other cooperation-checking sites
+  it is treated like ``nan`` (the attempt is poisoned and skipped).
 
 ``step`` is the 1-based global step number.  Each spec fires ONCE (the
 first time its site+step matches), so a retried site succeeds on the
-next attempt; every firing bumps the ``faults.fired`` counter.
+next attempt; every firing bumps the ``faults.fired`` counter — and,
+when telemetry is recording, emits a ``fault.fired`` event carrying
+``site``/``step``/``kind``, so a flight dump names the fault sites an
+incident replay must re-arm to reproduce the run.
 """
 
 from __future__ import annotations
@@ -92,7 +104,7 @@ SITES = frozenset(
         "serve.swap",
     }
 )
-KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan"})
+KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan", "corrupt"})
 
 _T_FIRED = _telemetry.counter("faults.fired")
 
@@ -204,6 +216,10 @@ def fire(site: str, step: int) -> Optional[str]:
     kind = _registry.check(site, step)
     if kind is None:
         return None
+    # Recorded BEFORE acting (a crash kind never returns): the trace —
+    # and any flight dump cut from it — names the injected fault, so an
+    # incident replay can re-arm the exact same schedule.
+    _telemetry.event("fault.fired", site=site, step=step, kind=kind)
     if kind == "io":
         raise InjectedFault(f"injected io fault at {site}:{step}")
     if kind == "fatal":

@@ -70,6 +70,7 @@ from .engine import Engine  # noqa: F401
 from .qos import QoSScheduler  # noqa: F401
 from .lifecycle import (  # noqa: F401
     DeadlineExceeded,
+    DeterminismDiverged,
     EngineDraining,
     EngineOverloaded,
     Health,
@@ -85,6 +86,7 @@ from .scheduler import FIFOScheduler, Request, RequestHandle  # noqa: F401
 __all__ = [
     "BlockAllocator",
     "DeadlineExceeded",
+    "DeterminismDiverged",
     "Engine",
     "EngineDraining",
     "EngineOverloaded",

@@ -122,6 +122,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import telemetry as _telemetry
+from ..telemetry import audit as _audit
 from ..telemetry import ops as _ops
 from ..telemetry import perf as _perf
 from ..models.generate import _sample
@@ -137,6 +138,7 @@ from .cache import (
 )
 from .lifecycle import (
     DeadlineExceeded,
+    DeterminismDiverged,
     EngineDraining,
     EngineOverloaded,
     Health,
@@ -170,6 +172,8 @@ _T_PREFIX_HITS = _telemetry.counter("serve.prefix_hits")
 _T_PREFIX_HIT_TOKENS = _telemetry.counter("serve.prefix_hit_tokens")
 _T_COW = _telemetry.counter("serve.cow_copies")
 _T_PREFIX_EVICTIONS = _telemetry.counter("serve.prefix_evictions")
+_T_IDLE_TICKS = _telemetry.counter("serve.idle_ticks")
+_T_CORRUPTIONS = _telemetry.counter("serve.corruptions")
 _G_RUNNING = _telemetry.gauge("serve.running_slots")
 _G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
 _G_TTFT = _telemetry.gauge("serve.ttft_s")
@@ -398,6 +402,23 @@ class Engine:
         training loop (or two engines) sharing a process would race for
         the notice.  Retire an engine without a drain via
         :meth:`close`, which restores the handlers it installed.
+    model_version : weights-version tag folded into every request's
+        determinism digest (docs/observability.md, "Audit plane").  Tag
+        real weight versions distinctly (hot-swap standbys especially):
+        the fleet's digest-based failover verification then rejects a
+        version-mixed stream even when the token ids happen to agree.
+    audit_sample : fraction of COMPLETED requests the shadow auditor
+        (:class:`torchdistx_tpu.telemetry.audit.ShadowAuditor`)
+        re-executes through the engine's own chunked-prefill + decode
+        programs — zero new compiled geometries — at the lowest QoS
+        class, only on ticks with no user work waiting, and
+        digest-compares against the original stream
+        (``TDX_AUDIT_SAMPLE`` when None; 0/unset = off).  A mismatch
+        bumps ``audit.divergences``, latches
+        ``serve.diverging{engine=...}`` (the engine reads OVERLOADED —
+        routed around like a stall — until :meth:`clear_divergence`),
+        and flight-dumps ``reason="divergence"`` with both token
+        streams for ``scripts/incident_replay.py`` to bisect.
     """
 
     def __init__(
@@ -430,9 +451,12 @@ class Engine:
         engine_id: Optional[str] = None,
         ops_port: Optional[int] = None,
         ops_config: Optional[_ops.OpsConfig] = None,
+        model_version: str = "v0",
+        audit_sample: Optional[float] = None,
     ):
         self.model = model
         self.cfg = cfg
+        self.model_version = str(model_version)
         self.engine_id = (
             str(engine_id) if engine_id is not None
             else f"eng{next(_ENGINE_SEQ)}"
@@ -612,6 +636,21 @@ class Engine:
         _G_HEALTH.set(self._health.value)
         self._lg_health.set(self._health.value)
 
+        # Audit plane (docs/observability.md, "Audit plane"): the
+        # divergence latch plus the opt-in shadow auditor.  Validation
+        # happens HERE, BEFORE the ops-plane attach and the perf-plane
+        # registrations below — a constructor that raises on a bad
+        # audit_sample must not leave a half-built engine watched by a
+        # plane no _finish_drain will ever unwatch.
+        self._diverging = False
+        if audit_sample is None:
+            audit_sample = _audit.env_audit_sample()
+        self._auditor: Optional[_audit.ShadowAuditor] = (
+            _audit.ShadowAuditor(self, audit_sample)
+            if audit_sample
+            else None
+        )
+
         # Live ops plane (docs/observability.md, "Ops plane").  The
         # tick counter always counts (one int add — the watchdog's
         # progress key reads it); everything else — the per-tick
@@ -620,6 +659,7 @@ class Engine:
         # ops.enable_tick_attribution() forced attribution on), so the
         # disabled path pays nothing per tick.
         self._tick_no = 0
+        self._was_idle = False  # last tick's idleness (gauge-zeroing edge)
         self._g_occupancy = None  # per-tick gauges, minted on first use
         self._ops_plane: Optional[_ops.OpsPlane] = None
         if ops_port is None:
@@ -627,6 +667,27 @@ class Engine:
         if ops_port is not None:
             self._ops_plane = _ops.attach_engine(
                 self, port=int(ops_port), config=ops_config
+            )
+        if _telemetry.events_enabled():
+            # The engine's geometry, stamped into the event stream: a
+            # flight dump then carries everything incident_replay.py
+            # needs to rebuild an equivalent engine (the weights come
+            # from the operator — bytes don't belong in a trace).
+            _telemetry.event(
+                "serve.engine_config",
+                engine=self.engine_id,
+                num_slots=num_slots,
+                block_size=block_size,
+                num_blocks=int(num_blocks),
+                max_model_len=self.max_model_len,
+                temperature=self.temperature,
+                top_k=top_k,
+                eos_id=eos_id,
+                decode_chunk=self.decode_chunk,
+                prefill_chunk=self.prefill_chunk,
+                max_prefills_per_tick=max_prefills_per_tick,
+                scheduler=scheduler,
+                model_version=self.model_version,
             )
 
         # Perf plane (docs/observability.md, "Perf plane"), LAST —
@@ -690,6 +751,7 @@ class Engine:
         priority: int = 0,
         trace_id: Optional[str] = None,
         hop: int = 0,
+        _audit_of: Optional[str] = None,
     ) -> RequestHandle:
         """Queue a request; returns its streaming handle.
 
@@ -842,13 +904,25 @@ class Engine:
             deadline=deadline, n_chunks=n_chunks, hashes=hashes,
             tenant=tenant, priority=priority,
             trace_id=trace_id, hop=int(hop),
+            digest=_audit.DeterminismDigest(prompt, key),
+            audit_of=_audit_of,
         )
         handle._req = req
+        # Traced requests carry their replay identity (prompt ids +
+        # normalized key) on req.submitted so a flight dump is a
+        # runnable repro (scripts/incident_replay.py); built ONLY when
+        # tracing — the disabled path allocates no lists.
+        extra = {}
+        if trace_id is not None:
+            extra["prompt"] = [int(t) for t in prompt]
+            extra["key"] = [int(k) for k in key]
+            if _audit_of is not None:
+                extra["audit_of"] = _audit_of
         self._event(
             "req.submitted", req,
             n_prompt=len(prompt), max_new=int(max_new_tokens),
             tenant=tenant, priority=priority,
-            deadline_s=deadline_s, n_chunks=n_chunks,
+            deadline_s=deadline_s, n_chunks=n_chunks, **extra,
         )
         self.scheduler.push(req)
         self._event("req.queued", req, queue_depth=len(self.scheduler))
@@ -856,9 +930,20 @@ class Engine:
         return handle
 
     def drain(self) -> None:
-        """Step until every submitted request has finished."""
-        while len(self.scheduler) or self._n_running():
+        """Step until every submitted request has finished — shadow
+        audits included: a drain leaves no sampled-but-unchecked
+        streams behind."""
+        while (
+            len(self.scheduler) or self._n_running() or self.audit_backlog()
+        ):
             self.step()
+
+    def audit_backlog(self) -> int:
+        """Shadow audits sampled but not yet submitted (0 with auditing
+        off).  In-flight audits occupy the ordinary queue/slots and are
+        visible there; drive loops that wait on ``scheduler``/running
+        should also wait on this."""
+        return 0 if self._auditor is None else self._auditor.backlog()
 
     def health(self) -> Health:
         """Current :class:`.lifecycle.Health` state."""
@@ -940,6 +1025,12 @@ class Engine:
             self._begin_drain()
         self._preempted_this_tick = False
         self._reap_phase()
+        if self._auditor is not None:
+            # Shadow audits ride the ordinary admission path, one per
+            # tick at most, and only when no user work waits (the pump
+            # checks) — before _admit_phase so a submitted audit admits
+            # this same tick on an otherwise idle engine.
+            self._auditor.pump()
         if self._health is not Health.DRAINING:
             self._admit_phase()
         # Swapped slots resume even while DRAINING — they are in-flight
@@ -956,16 +1047,42 @@ class Engine:
             self._drain_tick()
         elif self._health is Health.STARTING:
             self._set_health(Health.READY)
-        elif self._health is Health.OVERLOADED and not self.detector.overloaded(
-            len(self.scheduler), self.max_prefills_per_tick,
-            queued_chunks=self._pending_prefill_chunks(),
+        elif (
+            self._health is Health.OVERLOADED
+            # The divergence latch does NOT self-clear: a determinism
+            # break is not pressure that drains away (clear_divergence).
+            and not self._diverging
+            and not self.detector.overloaded(
+                len(self.scheduler), self.max_prefills_per_tick,
+                queued_chunks=self._pending_prefill_chunks(),
+            )
         ):
             self._set_health(Health.READY)
         tick_s = time.perf_counter() - t0
         self.detector.observe_tick(tick_s)
         self._tick_no += 1
-        if ops_on:
+        # A fully idle tick (nothing ran, nothing waiting) publishes NO
+        # attribution: idle readings would dilute occupancy/goodput
+        # stats into meaninglessness on a lightly loaded engine.  It
+        # still counts — an operator can tell idle from wedged — and
+        # the FIRST idle tick zeroes the per-tick rate gauges once, so
+        # a dashboard never reads the last busy tick's goodput off an
+        # engine that has gone quiet.
+        idle = (
+            committed == 0 and chunks == 0 and not self._swapped
+            and not len(self.scheduler) and self._n_running() == 0
+            and self._health is not Health.STOPPED  # drain-completing tick
+        )
+        if idle:
+            _T_IDLE_TICKS.add()
+            if ops_on and not self._was_idle and self._g_occupancy is not None:
+                self._g_occupancy.set(0)
+                self._g_prefill_budget.set(0)
+                self._g_churn.set(0)
+                self._g_goodput.set(0)
+        elif ops_on:
             self._tick_telemetry(tick_s, chunks, committed, churn0)
+        self._was_idle = idle
         # A tick that completed the drain must not re-write the routing
         # gauges _finish_drain just cleared — a stopped engine leaves no
         # stale readings behind.  A live engine re-asserts BOTH every
@@ -1053,6 +1170,27 @@ class Engine:
         the normal overload re-check."""
         if self._health in (Health.STARTING, Health.READY):
             self._set_health(Health.OVERLOADED)
+
+    def _mark_diverging(self) -> None:
+        """Divergence hook (:mod:`torchdistx_tpu.telemetry.audit`): a
+        shadow-audit digest mismatch or a failed resume verification
+        LATCHES this engine — ``serve.diverging{engine=...}`` set, and
+        the engine reads OVERLOADED so a fleet router routes around it
+        the same way it routes around stalls and recompile storms.
+        Unlike those, the latch never self-clears: ticks keep serving
+        in-flight work, but only :meth:`clear_divergence` (an operator
+        action, after incident replay) restores routability."""
+        self._diverging = True
+        _telemetry.gauge("serve.diverging", engine=self.engine_id).set(1)
+        if self._health in (Health.STARTING, Health.READY):
+            self._set_health(Health.OVERLOADED)
+
+    def clear_divergence(self) -> None:
+        """Operator acknowledgement: drop the divergence latch (the
+        gauge reads 0 until the engine stops); the next tick's overload
+        re-check restores READY when no real pressure remains."""
+        self._diverging = False
+        _telemetry.gauge("serve.diverging", engine=self.engine_id).set(0)
 
     # ------------------------------------------------------------------
     # Perf plane: HBM ledger sync + OOM forensics
@@ -1231,6 +1369,9 @@ class Engine:
         if self._ops_plane is not None:
             self._ops_plane.unwatch(self)
             self._ops_plane = None
+        # The divergence latch gauge is a dynamic label family: prune it
+        # with the engine (the flag itself survives for introspection).
+        _telemetry.remove("serve.diverging", engine=self.engine_id)
         # HBM ledger teardown: a stopped engine's pool/swap/prefix
         # accounts leave the ledger; weights leave when the LAST engine
         # sharing the params pytree stops (peers may still serve it).
@@ -1556,6 +1697,15 @@ class Engine:
             ),
         ):
             req = self._slot_req[slot]
+            toks = req.handle._tokens
+            if toks and not req.digest.matches_stream(
+                req.prompt, req.key, toks, self.model_version
+            ):
+                # Digest verification before the pages come back: a
+                # corrupted committed buffer fails typed here — the
+                # KV about to be mapped in no longer matches it.
+                self._resume_diverged(slot, req, "swap-resume")
+                continue
             host, layout = self._swapped[slot]
             n_priv = sum(1 for kept in layout if kept is None)
             reserve = 0
@@ -1843,6 +1993,15 @@ class Engine:
             # discard it; the pending input is the last committed token
             # and the key schedule continues at fold_in(key, n_gen).
             # TTFT was recorded at the original first token.
+            # The resume verifies the committed buffer against the
+            # request's determinism digest FIRST (O(1) memory — one
+            # re-hash, one compare): a corrupted buffer must fail
+            # typed, never silently poison the continuation.
+            if not req.digest.matches_stream(
+                req.prompt, req.key, toks, self.model_version
+            ):
+                self._resume_diverged(slot, req, "preempt-replay-resume")
+                return
             self._tokens[slot] = toks[-1]
             self._positions[slot] = req.replay_len()
             self._n_gen[slot] = len(toks)
@@ -1866,9 +2025,15 @@ class Engine:
             req.preempt_t = None
         elif req.admit_t is not None:
             self._h_prefill.observe(now - req.admit_t)
-        self._event(
-            "req.first_token", req, ttft_s=round(req.handle.ttft_s, 6)
-        )
+        if req.trace_id is not None:
+            # The digest here is the request's ADMITTED identity
+            # (prompt bytes + key schedule, no tokens yet) — enough to
+            # match a first-token event against an incident replay; the
+            # full-stream snapshot lands on req.finished.
+            self._event(
+                "req.first_token", req, ttft_s=round(req.handle.ttft_s, 6),
+                digest=req.digest.hexdigest(),
+            )
         _G_TTFT.set(round(req.handle.ttft_s, 4))
         s = len(req.prompt)
         self._tokens[slot] = first
@@ -1892,6 +2057,32 @@ class Engine:
         req.table = None
         req.prefill_pos = 0
         req.n_cached = 0
+
+    def _resume_diverged(self, slot: int, req: Request, where: str) -> None:
+        """A resume's digest verification failed: the committed-token
+        buffer was corrupted while the stream was parked.  Latch the
+        engine (divergence funnel: ``audit.divergences`` + the
+        ``serve.diverging`` gauge + a flight dump) and fail the request
+        typed — never feed a poisoned buffer back to the model."""
+        toks = list(req.handle._tokens)
+        _audit.record_divergence(
+            self,
+            rid=req.trace_id,
+            where=where,
+            expected_digest=req.digest.hexdigest(),
+            replayed_digest=_audit.DeterminismDigest.of_stream(
+                req.prompt, req.key, toks, self.model_version
+            ).hexdigest(),
+            n_tokens=len(toks),
+        )
+        self._fail_running_slot(
+            slot,
+            DeterminismDiverged(
+                f"request {req.rid} resume ({where}): committed tokens no "
+                f"longer match the determinism digest after {len(toks)} "
+                "tokens"
+            ),
+        )
 
     def _abort_prefill(self, slot: int) -> Request:
         """Back a PREFILLING slot fully out: pages returned (shared ones
@@ -1983,6 +2174,12 @@ class Engine:
             # train loop's skip-step guard), count it, keep going.
             _T_SKIPPED.add()
             return 0
+        # "corrupt" (audit-plane fault, docs/resilience.md): the chunk
+        # runs normally, then ONE committed token is flipped on the
+        # host — a silent single-bit determinism break the shadow
+        # auditor must catch (nothing else will: the device state keeps
+        # the true token, so the stream stays plausible).
+        corrupt = kind == "corrupt"
         sp = _telemetry.start_span(
             "serve.step",
             n_active=self._n_decoding(),
@@ -2024,6 +2221,19 @@ class Engine:
             self._supervise_recovery(err)
             return 0
         out = np.asarray(out)  # (chunk, S) — the one host sync per chunk
+        if corrupt:
+            out = out.copy()  # the jax-backed view may be read-only
+            for slot in range(self.num_slots):
+                if (
+                    self._slot_req[slot] is not None
+                    and slot not in self._prefill_q
+                    and slot not in self._swapped
+                ):
+                    # Deterministic victim: the first decoding slot's
+                    # first token of this chunk, XOR 1.
+                    out[0, slot] = int(out[0, slot]) ^ 1
+                    _T_CORRUPTIONS.add()
+                    break
         self._consec_decode_failures = 0
         dt = time.perf_counter() - t0
         self._decode_s += dt
@@ -2137,6 +2347,17 @@ class Engine:
         self._swap_host_bytes = 0
         if self.prefix is not None:
             self.prefix.clear()
+        # Replay inputs verify against the determinism digest BEFORE
+        # anything is re-prefilled: the supervisor replays exactly the
+        # committed stream or fails it typed — never a corrupted one.
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            toks = req.handle._tokens
+            if toks and not req.digest.matches_stream(
+                req.prompt, req.key, toks, self.model_version
+            ):
+                self._resume_diverged(slot, req, "recovery-replay")
         pending = [
             (slot, req)
             for slot, req in enumerate(self._slot_req)
@@ -2234,9 +2455,13 @@ class Engine:
     # Token commit / retirement
 
     def _push_token(self, slot: int, token: int) -> None:
-        """Commit one token to the slot's handle; retire on EOS/budget."""
+        """Commit one token to the slot's handle; retire on EOS/budget.
+        The commit IS the digest update: the rolling determinism digest
+        covers exactly the committed stream, whatever preemptions or
+        recoveries happened between chunks (resumes re-commit nothing)."""
         req = self._slot_req[slot]
         req.handle._push(token)
+        req.digest.update((token,), self.model_version)
         self._emitted[slot] += 1
         _T_TOKENS.add()
         if self._emitted[slot] >= req.max_new_tokens or (
@@ -2251,6 +2476,10 @@ class Engine:
         req.handle._finish()
         _T_FINISHED.add()
         self._clear_slot(slot)
+        if self._auditor is not None:
+            # Completed requests feed the shadow auditor (audit replays
+            # settle their digest comparison through the same hook).
+            self._auditor.on_finished(req)
 
     def _clear_slot(self, slot: int) -> None:
         self._slot_req[slot] = None
@@ -2297,6 +2526,14 @@ class Engine:
             out["prefix_hit_tokens"] = self.prefix.hit_tokens
             out["prefix_evictions"] = self.prefix.evictions
             out["cow_copies"] = self._n_cow
+        if self._auditor is not None:
+            out["audit_checked"] = self._auditor.checked
+            out["audit_divergences"] = self._auditor.divergences
+            out["audit_pending"] = self._auditor.backlog()
+            out["audit_dropped"] = self._auditor.dropped
+            out["audit_aborted"] = self._auditor.aborted
+        if self._diverging:
+            out["diverging"] = True
         if self._decode_s > 0:
             out["decode_tokens_per_s"] = round(
                 self._decode_tokens / self._decode_s, 1

@@ -33,6 +33,7 @@ from typing import Optional
 
 __all__ = [
     "DeadlineExceeded",
+    "DeterminismDiverged",
     "EngineDraining",
     "EngineOverloaded",
     "Health",
@@ -127,6 +128,16 @@ class RecoveryFailed(RequestError):
     budget (``max_recoveries``) without completing it."""
 
     retryable = True
+
+
+class DeterminismDiverged(RequestError):
+    """A resume's committed-token buffer no longer matches the request's
+    determinism digest (docs/observability.md, "Audit plane"): the
+    stream was corrupted between commit and resume, and feeding it back
+    to the model would silently poison the continuation.  NOT retryable
+    — the engine latches ``serve.diverging`` and a human (or the
+    incident-replay tooling) owns the next move; a blind retry cannot
+    restore a broken determinism invariant."""
 
 
 class OverloadDetector:

@@ -82,6 +82,14 @@ class Request:
     # Phase-timing marks (engine-owned; feed the latency histograms):
     admit_t: Optional[float] = None  # first admission (queue-wait end)
     preempt_t: Optional[float] = None  # outage start (preempt/recovery)
+    # Audit plane (docs/observability.md): the rolling determinism
+    # digest over (prompt, key schedule, model version, committed
+    # tokens) — created at submit, updated at every token commit,
+    # verified at every resume.  ``audit_of`` marks a shadow-auditor
+    # replay (the trace id of the request it re-executes): audit
+    # replays are never themselves audited.
+    digest: Optional[Any] = None
+    audit_of: Optional[str] = None
 
     @property
     def cache_tokens(self) -> int:
@@ -129,6 +137,16 @@ class RequestHandle:
     def done(self) -> bool:
         return self._done
 
+    @property
+    def digest(self) -> Optional[str]:
+        """Hex snapshot of the request's rolling determinism digest
+        (docs/observability.md, "Audit plane"); None before submission
+        wiring completes."""
+        req = self._req
+        if req is None or req.digest is None:
+            return None
+        return req.digest.hexdigest()
+
     def cancel(self) -> bool:
         """Request cancellation.  Takes effect at the next chunk
         boundary (waiting requests leave the queue, running requests
@@ -162,7 +180,19 @@ class RequestHandle:
 
     def _finish(self) -> None:
         self._done = True
-        self._event("req.finished", n_tokens=len(self._tokens))
+        req = self._req
+        if req is not None and req.trace_id is not None and (
+            req.digest is not None
+        ):
+            # The digest snapshot is stamped ONLY on traced requests —
+            # the disabled path formats no hex strings.
+            self._event(
+                "req.finished",
+                n_tokens=len(self._tokens),
+                digest=req.digest.hexdigest(),
+            )
+        else:
+            self._event("req.finished", n_tokens=len(self._tokens))
 
     def _fail(self, error: BaseException) -> None:
         """Abort the request with a typed error (see :mod:`.lifecycle`):

@@ -1,0 +1,397 @@
+"""Audit plane: determinism digests, shadow auditing, divergence latching.
+
+Every hard guarantee the serving stack makes — crash-recovery replay,
+QoS preempt-and-resume, fleet failover, hot swap — rests on ONE
+invariant: a replay under the ``fold_in(key, n_gen)`` sampling schedule
+is **token-identical** to the uninterrupted run.  Until now that
+invariant was verified only in tests and chaos soaks; in production it
+was unobservable.  This module makes determinism itself a continuously
+measured signal:
+
+* :class:`DeterminismDigest` — a rolling blake2b over *(admitted
+  prompt, sampling-key schedule, model version, committed token ids)*.
+  Every request carries one, updated as tokens commit at chunk
+  boundaries; its hex snapshot is stamped into the ``req.first_token``
+  and ``req.finished`` lifecycle events (and therefore ``/requests``
+  and every flight dump).  The update rule is **per token** — version
+  bytes then the token's little-endian bytes — so a digest computed
+  from chunked engine commits, a per-token fleet stream, or a flat
+  list recomputation all agree bit-for-bit.  Verification against a
+  digest is O(1) memory where the pre-audit stack compared buffered
+  token lists element-by-element:
+
+  - the **fleet failover prefix check** (``FleetHandle.tokens()``)
+    re-hashes the replacement stream's prefix and compares ONE digest
+    against the committed one — and because the engine's
+    ``model_version`` folds into every token, a deliberately
+    version-mixed replay is rejected even when the token ids happen to
+    agree;
+  - **preempt/replay resume** (drop-and-replay ``_complete_prefill``,
+    ``_swap_in_phase``, the crash-recovery supervisor) re-hashes the
+    committed stream before feeding it back to the model, so a
+    corrupted host-side token buffer can never silently poison a
+    resume.
+
+* :class:`ShadowAuditor` — an opt-in (``Engine(audit_sample=p)`` /
+  ``TDX_AUDIT_SAMPLE``) background auditor that re-executes a sampled
+  fraction of *completed* requests through the engine's own chunked
+  prefill + decode programs (zero new compiled geometries) at the
+  lowest QoS class, only on ticks where no user work waits.  The
+  replay's digest must equal the original's; a mismatch bumps
+  ``audit.divergences``, latches the engine's
+  ``serve.diverging{engine=}`` gauge (the engine reads OVERLOADED so a
+  fleet router routes around it, exactly like a stall or a recompile
+  storm — but the latch does NOT self-clear: determinism breaks need a
+  human, see :meth:`~torchdistx_tpu.serving.engine.Engine
+  .clear_divergence`), and flight-dumps ``reason="divergence"``
+  carrying BOTH token streams — the input
+  ``scripts/incident_replay.py`` bisects to the first diverging chunk.
+
+* :func:`record_divergence` — the one funnel every divergence
+  (auditor mismatch, resume-verification failure) goes through:
+  counter + latch + flight dump.
+
+Metrics (docs/observability.md, "Audit plane"): ``audit.checked``,
+``audit.divergences``, ``audit.dropped``, ``audit.aborted`` counters
+and the per-engine ``serve.diverging{engine=}`` latch gauge.
+
+Like the rest of :mod:`torchdistx_tpu.telemetry`, this module imports
+nothing heavy at module level (numpy/jax load lazily inside the
+functions that need them) and costs nothing when unused.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import _core as _telemetry
+
+__all__ = [
+    "AUDIT_PRIORITY",
+    "DeterminismDigest",
+    "ShadowAuditor",
+    "canonical_key",
+    "env_audit_sample",
+    "first_divergence",
+    "record_divergence",
+    "token_chunk",
+]
+
+# The shadow auditor's QoS class: strictly below any sane user
+# priority, so an audit replay can never preempt (or outqueue) real
+# work on a QoS engine.  Inert under the FIFO scheduler — there the
+# auditor's only-when-quiet pump is the whole protection.
+AUDIT_PRIORITY = -(2**30)
+
+_T_CHECKED = _telemetry.counter("audit.checked")
+_T_DIVERGENCES = _telemetry.counter("audit.divergences")
+_T_DROPPED = _telemetry.counter("audit.dropped")
+_T_ABORTED = _telemetry.counter("audit.aborted")
+
+
+def env_audit_sample() -> Optional[float]:
+    """``TDX_AUDIT_SAMPLE`` as a float in [0, 1], or None when unset.
+    A malformed value raises — a mistyped sampling rate silently
+    auditing nothing would defeat the whole plane (the ``TDX_FAULT``
+    grammar philosophy)."""
+    text = os.environ.get("TDX_AUDIT_SAMPLE", "")
+    if not text:
+        return None
+    try:
+        value = float(text)
+    except ValueError:
+        raise ValueError(
+            f"TDX_AUDIT_SAMPLE={text!r}: expected a float in [0, 1]"
+        ) from None
+    if not 0.0 <= value <= 1.0:
+        raise ValueError(
+            f"TDX_AUDIT_SAMPLE={value}: expected a fraction in [0, 1]"
+        )
+    return value
+
+
+def canonical_key(key: Any):
+    """The engine's key normalization, importable: an int seed becomes
+    ``jax.random.PRNGKey(seed)``, anything array-like becomes the
+    ``(2,) uint32`` raw key — so a digest seeded anywhere (engine,
+    fleet handle, incident replay) hashes the same bytes for the same
+    ``submit(key=...)`` argument."""
+    import numpy as np
+
+    if isinstance(key, (int, np.integer)):
+        import jax
+
+        key = jax.random.PRNGKey(int(key))
+    return np.asarray(key).astype(np.uint32).reshape(2)
+
+
+def _prompt_bytes(prompt) -> bytes:
+    import numpy as np
+
+    return np.ascontiguousarray(prompt, dtype="<i4").tobytes()
+
+
+def _key_bytes(key) -> bytes:
+    import numpy as np
+
+    return np.ascontiguousarray(key, dtype="<u4").tobytes()
+
+
+class DeterminismDigest:
+    """Rolling blake2b over one request's deterministic identity.
+
+    Seeded with the admitted prompt's token bytes and the normalized
+    sampling key (the key IS the schedule: every sampling step derives
+    ``fold_in(key, n_gen)`` from it); updated per committed token with
+    the serving engine's ``model_version`` bytes followed by the token
+    id.  Chunk-size invariant by construction, O(1) state however long
+    the stream, and snapshot-able at any point (``hexdigest`` copies
+    the hash state — the rolling digest keeps accumulating)."""
+
+    __slots__ = ("_h", "n")
+
+    def __init__(self, prompt, key):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(_prompt_bytes(prompt))
+        h.update(_key_bytes(key))
+        self._h = h
+        self.n = 0  # committed tokens folded in so far
+
+    def update(self, tokens, version: str = "v0") -> None:
+        """Fold committed token ids in (one call per chunk boundary in
+        the engine; one call per token on the fleet's verify path —
+        identical result either way)."""
+        v = str(version).encode()
+        h = self._h
+        for tok in tokens:
+            h.update(v)
+            h.update(int(tok).to_bytes(8, "little", signed=True))
+            self.n += 1
+
+    def hexdigest(self) -> str:
+        """Snapshot of the digest so far (the stream keeps rolling)."""
+        return self._h.copy().hexdigest()
+
+    @classmethod
+    def of_stream(
+        cls, prompt, key, tokens, version: str = "v0"
+    ) -> "DeterminismDigest":
+        """The digest a single-engine stream of ``tokens`` would carry."""
+        d = cls(prompt, key)
+        d.update(tokens, version)
+        return d
+
+    def matches_stream(
+        self, prompt, key, tokens, version: str = "v0"
+    ) -> bool:
+        """O(1)-memory verification that this digest covers exactly
+        ``tokens`` (the preempt/replay resume check: re-hash the
+        committed buffer, compare one digest — never compare lists)."""
+        return (
+            self.of_stream(prompt, key, tokens, version).hexdigest()
+            == self.hexdigest()
+        )
+
+
+def first_divergence(expected: List[int], got: List[int]) -> int:
+    """Index of the first differing token between two streams (the
+    shorter stream's end when one is a strict prefix of the other)."""
+    n = min(len(expected), len(got))
+    for i in range(n):
+        if int(expected[i]) != int(got[i]):
+            return i
+    return n
+
+
+def token_chunk(index: int, decode_chunk: int) -> int:
+    """Map a per-request token index onto the chunk that committed it:
+    token 0 is the prefill's first-token sample (chunk 0); decode chunk
+    ``j`` (1-based) commits tokens ``1 + (j-1)*decode_chunk ..
+    j*decode_chunk``."""
+    if index <= 0:
+        return 0
+    return 1 + (index - 1) // max(1, int(decode_chunk))
+
+
+def record_divergence(engine, **detail) -> None:
+    """The one divergence funnel: bump ``audit.divergences``, latch the
+    engine (``serve.diverging{engine=}`` + OVERLOADED so routers route
+    around), and flight-dump ``reason="divergence"`` with the caller's
+    forensics (both token streams, digests, first diverging chunk)."""
+    _T_DIVERGENCES.add()
+    mark = getattr(engine, "_mark_diverging", None)
+    if mark is not None:
+        mark()
+    _telemetry.flight_dump(
+        "divergence", engine=getattr(engine, "engine_id", None), **detail
+    )
+
+
+class _AuditRecord:
+    """One completed request's identity, queued for shadow re-execution."""
+
+    __slots__ = (
+        "trace_id", "rid", "prompt", "key", "max_new", "digest", "tokens",
+    )
+
+    def __init__(self, req, engine_id: str):
+        self.trace_id = req.trace_id or f"{engine_id}-r{req.rid}"
+        self.rid = req.rid
+        self.prompt = req.prompt
+        self.key = req.key
+        self.max_new = req.max_new_tokens
+        self.digest = req.digest.hexdigest()
+        self.tokens = list(req.handle._tokens)
+
+
+class ShadowAuditor:
+    """Re-execute a sampled fraction of completed requests and compare
+    determinism digests (docs/observability.md, "Audit plane").
+
+    Owned by one engine.  ``on_finished`` (called by the engine at
+    every retirement) either enqueues the finished request for audit
+    (sampling is deterministic off the request's own digest, so a
+    replayed trace samples the same requests) or — when the finished
+    request IS an audit replay — compares digests and routes any
+    mismatch through :func:`record_divergence`.  ``pump`` (called once
+    per engine tick) submits at most one pending audit, and only while
+    the engine's own queue is empty: shadow traffic must never delay,
+    shed, or preempt user work.  An audit replay goes through the
+    ordinary ``submit`` path — same chunked prefill, same decode chunk,
+    same prefix cache — so auditing compiles **zero** new geometries.
+
+    The pending queue is bounded (``max_pending``): under sustained
+    saturation the oldest un-started audits drop (``audit.dropped``)
+    rather than growing host memory — coverage degrades, correctness
+    doesn't.  Audit replays killed by a drain/close/shed fail with
+    their typed errors like any request and are counted
+    ``audit.aborted``, never as divergences."""
+
+    def __init__(
+        self,
+        engine,
+        sample: float,
+        *,
+        priority: int = AUDIT_PRIORITY,
+        max_pending: int = 32,
+    ):
+        sample = float(sample)
+        if not 0.0 <= sample <= 1.0:
+            raise ValueError(
+                f"audit_sample {sample}: expected a fraction in [0, 1]"
+            )
+        self.engine = engine
+        self.sample = sample
+        self.priority = int(priority)
+        self.max_pending = int(max_pending)
+        self._pending: deque = deque()
+        self._inflight: Dict[int, tuple] = {}  # audit rid -> (record, handle)
+        self.checked = 0
+        self.divergences = 0
+        self.dropped = 0
+        self.aborted = 0
+        self.divergence_detail: List[Dict[str, Any]] = []
+
+    # -- engine hooks -------------------------------------------------------
+
+    def backlog(self) -> int:
+        """Audits not yet submitted (in-flight ones occupy the engine's
+        own queue/slots and are visible there)."""
+        return len(self._pending)
+
+    def on_finished(self, req) -> None:
+        """Every retirement lands here: enqueue user requests (sampled),
+        settle audit replays."""
+        if req.audit_of is not None:
+            self._compare(req)
+            return
+        if self.sample <= 0.0 or req.digest is None:
+            return
+        if not self._sampled(req):
+            return
+        if len(self._pending) >= self.max_pending:
+            self._pending.popleft()
+            self.dropped += 1
+            _T_DROPPED.add()
+        self._pending.append(_AuditRecord(req, self.engine.engine_id))
+
+    def pump(self) -> None:
+        """One engine tick's worth of audit progress: reap failed
+        replays, then submit at most one pending audit if the engine is
+        quiet (empty queue; health still serving)."""
+        if self._inflight:
+            self._reap_failed()
+        if not self._pending:
+            return
+        eng = self.engine
+        if eng.health().value not in ("starting", "ready", "overloaded"):
+            # Draining/stopped: these audits will never run.
+            self._pending.clear()
+            return
+        if len(eng.scheduler):
+            return  # user work waiting — shadow traffic yields
+        rec = self._pending[0]
+        try:
+            handle = eng.submit(
+                rec.prompt,
+                max_new_tokens=rec.max_new,
+                key=rec.key,
+                tenant="_audit",
+                priority=self.priority,
+                _audit_of=rec.trace_id,
+            )
+        except Exception:  # noqa: BLE001 — overloaded/draining: retry later
+            return
+        self._pending.popleft()
+        self._inflight[handle.rid] = (rec, handle)
+
+    # -- internals ----------------------------------------------------------
+
+    def _sampled(self, req) -> bool:
+        if self.sample >= 1.0:
+            return True
+        # Deterministic per request: the digest's leading 32 bits as a
+        # uniform draw — a replayed trace audits the same requests.
+        draw = int(req.digest.hexdigest()[:8], 16) / float(0xFFFFFFFF)
+        return draw < self.sample
+
+    def _reap_failed(self) -> None:
+        for rid in [
+            rid
+            for rid, (_, handle) in self._inflight.items()
+            if handle.done and handle.error is not None
+        ]:
+            self._inflight.pop(rid)
+            self.aborted += 1
+            _T_ABORTED.add()
+
+    def _compare(self, req) -> None:
+        entry = self._inflight.pop(req.rid, None)
+        if entry is None:
+            return
+        rec, _ = entry
+        self.checked += 1
+        _T_CHECKED.add()
+        got = req.digest.hexdigest()
+        if got == rec.digest:
+            return
+        self.divergences += 1
+        replayed = list(req.handle._tokens)
+        idx = first_divergence(rec.tokens, replayed)
+        detail = {
+            "rid": rec.trace_id,
+            "audit_rid": req.trace_id,
+            "expected_digest": rec.digest,
+            "replayed_digest": got,
+            "expected_tokens": rec.tokens,
+            "replayed_tokens": replayed,
+            "first_diverging_token": idx,
+            "first_diverging_chunk": token_chunk(
+                idx, getattr(self.engine, "decode_chunk", 1)
+            ),
+        }
+        self.divergence_detail.append(detail)
+        record_divergence(self.engine, **detail)
