@@ -615,11 +615,15 @@ def bench_serving():
     def run_trace(eng, trace_prompts, trace_outs, trace_arrival):
         peak_util = 0.0
         # The per-tick attribution gauges (docs/observability.md, "Ops
-        # plane"), sampled every tick: mean decode-batch occupancy and
-        # mean goodput over the ticks that actually decoded.
+        # plane"), sampled every tick: mean decode-batch occupancy,
+        # mean goodput, and the time plane's host/device split over the
+        # ticks that actually decoded.
         g_occ = telemetry.gauge("serve.occupancy", engine=eng.engine_id)
         g_good = telemetry.gauge("serve.goodput", engine=eng.engine_id)
-        occ_sum = good_sum = 0.0
+        g_host = telemetry.gauge(
+            "serve.host_overhead_frac", engine=eng.engine_id
+        )
+        occ_sum = good_sum = host_sum = 0.0
         decode_ticks = 0
         t0 = time.perf_counter()
         i, tick = 0, 0
@@ -641,17 +645,39 @@ def bench_serving():
                 decode_ticks += 1
                 occ_sum += occ
                 good_sum += g_good.value or 0.0
+                host_sum += g_host.value or 0.0
         st = eng.stats()
         if decode_ticks:
             st["mean_decode_batch_occupancy"] = round(
                 occ_sum / decode_ticks, 4
             )
             st["goodput_tokens_per_s"] = round(good_sum / decode_ticks, 1)
+            st["host_overhead_frac"] = round(host_sum / decode_ticks, 4)
         return time.perf_counter() - t0, peak_util, st
+
+    def tick_phase_rows(eng):
+        """The time plane's per-tick phase breakdown for one engine
+        (docs/observability.md, "Time plane"): per-phase count/total/
+        p50/p95 from timeplane.phase_summaries — the one readback over
+        the serve.tick_phase_s{engine=,phase=} histogram family."""
+        from torchdistx_tpu.telemetry import timeplane
+
+        return {
+            phase: {
+                "count": summ["count"],
+                "total_s": round(summ["sum"], 4),
+                "p50_s": round(summ["p50"], 6),
+                "p95_s": round(summ["p95"], 6),
+            }
+            for phase, summ in timeplane.phase_summaries(
+                eng.engine_id
+            ).items()
+        }
 
     telemetry.drain()  # warm-up records are not the measured trace
     eng = make_engine()
     wall, peak_util, st = run_trace(eng, prompts, outs, arrival)
+    headline_phases = tick_phase_rows(eng)
     total_tokens = int(sum(outs))
     # Reconstruct the measured run's own event stream — bench numbers
     # ride the same per-request timeline path as a production trace.
@@ -693,6 +719,7 @@ def bench_serving():
                 "mean_decode_batch_occupancy"
             ),
             "goodput_tokens_per_s": p_st.get("goodput_tokens_per_s"),
+            "host_overhead_frac": p_st.get("host_overhead_frac"),
         }
         if cache_on:
             row["prefix_hit_rate"] = round(p_st["prefix_hits"] / n_req, 3)
@@ -923,6 +950,11 @@ def bench_serving():
         # — the serving analogue of train-side MFU.
         "mean_decode_batch_occupancy": st.get("mean_decode_batch_occupancy"),
         "goodput_tokens_per_s": st.get("goodput_tokens_per_s"),
+        # Time plane (ISSUE 15): host vs device split of the tick loop
+        # (mean over decoding ticks — near 1 means host-bound, a faster
+        # kernel buys nothing) and the per-phase tick decomposition.
+        "host_overhead_frac": st.get("host_overhead_frac"),
+        "tick_phase_s": headline_phases,
         # The run's own reconstructed timelines (scripts/trace_report.py):
         # every request must reconstruct complete, and the phase totals
         # say where the wall time went.

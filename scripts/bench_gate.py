@@ -227,9 +227,25 @@ def run_fast() -> Dict[str, Any]:
             tick += 1
         return time.perf_counter() - t0, eng.stats()
 
+    from torchdistx_tpu.telemetry import ops as tdx_ops
+
     c0 = telemetry.counters()
+    # Time plane on (no HTTP listener): the fast round must produce the
+    # host/device split and the tick-phase breakdown the full bench
+    # reports — invariants checked in main().
+    prev_attr = tdx_ops.enable_tick_attribution(True)
     eng = make_engine()
     wall, st = run_trace(eng)
+    from torchdistx_tpu.telemetry import timeplane
+
+    host_frac = telemetry.gauge(
+        "serve.host_overhead_frac", engine=eng.engine_id
+    ).value
+    tick_phases = {
+        phase: summ["count"]
+        for phase, summ in timeplane.phase_summaries(eng.engine_id).items()
+    }
+    tdx_ops.enable_tick_attribution(prev_attr)
     # The same trace with the shadow auditor at 100% sampling: the
     # decode-recompile invariant below covers this run too — audit
     # replays must compile NOTHING new — and the sustained ratio is
@@ -283,6 +299,8 @@ def run_fast() -> Dict[str, Any]:
                 "compile_counts": compile_counts,
                 "decode_recompiles_steady": decode_recompiles,
                 "hbm_bytes": hbm,
+                "host_overhead_frac": host_frac,
+                "tick_phase_counts": tick_phases,
                 "audit": audit_row,
             }
         },
@@ -342,6 +360,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not fast["hbm_bytes"]:
             invariant_failures.append(
                 "HBM ledger empty: mem.hbm_bytes{component=} rows missing"
+            )
+        hf = fast.get("host_overhead_frac")
+        if hf is None or not 0.0 <= hf <= 1.0:
+            invariant_failures.append(
+                f"host_overhead_frac missing or out of [0,1]: {hf!r} — "
+                "the time plane's tick decomposition did not run"
+            )
+        if not fast.get("tick_phase_counts"):
+            invariant_failures.append(
+                "serve.tick_phase_s rows missing — no tick-phase "
+                "breakdown recorded"
             )
         audit = fast.get("audit") or {}
         if not audit.get("audit_checked"):

@@ -38,7 +38,12 @@ in-flight work.  The stall watchdog must detect it within the deadline,
 flight-dump ``reason=stall``, set ``serve.stalled``/503 ``/healthz``,
 and mark the engine OVERLOADED; resuming ticks must clear the latch and
 finish the stream token-identical, and ``Engine.close()`` must tear the
-listener down (connection refused).
+listener down (connection refused).  ISSUE 15 rides this phase: a
+profiler trigger is installed for it, the stall must fire EXACTLY ONE
+rate-limited capture (an ``ops.profile`` event whose artifact path
+exists on disk), and a second trigger inside the cooldown must be
+suppressed; the final trace assertion also requires the time plane's
+``serve.tick`` phase events (the Perfetto exporter's tick-loop track).
 
 Phase 2 — drain: under live load, a real SIGTERM goes through the real
 handler chain.  The engine must reach STOPPED within the drain deadline,
@@ -116,9 +121,9 @@ def fail(msg: str) -> int:
 
 
 def parse_trace(path):
-    """Span names + merged counter snapshots + flight-dump reasons from
-    a JSONL trace."""
-    spans, counters, dumps = set(), {}, []
+    """Span names + merged counter snapshots + flight-dump reasons +
+    per-name event records from a JSONL trace."""
+    spans, counters, dumps, events = set(), {}, [], {}
     with open(path) as f:
         for line in f:
             rec = json.loads(line)
@@ -128,7 +133,9 @@ def parse_trace(path):
                 counters.update(rec.get("values", {}))
             elif rec.get("type") == "flight_dump":
                 dumps.append(rec.get("reason"))
-    return spans, counters, dumps
+            elif rec.get("type") == "event":
+                events.setdefault(rec.get("name"), []).append(rec)
+    return spans, counters, dumps, events
 
 
 def check_exposition(text):
@@ -584,7 +591,22 @@ def main() -> int:
     import urllib.error
     import urllib.request
 
+    from torchdistx_tpu.telemetry import timeplane
+
     faults.reset("")
+    # Trigger-fired profiler capture (ISSUE 15 acceptance): the wedge's
+    # stall must fire EXACTLY ONE rate-limited capture — an ops.profile
+    # event with an existing artifact path — and a second trigger inside
+    # the cooldown must be suppressed.  The trigger is installed for
+    # this phase only (a long cooldown pins "exactly one"); earlier
+    # phases fire no captures because no trigger was installed.
+    profile_dir = os.path.join(
+        os.path.dirname(os.path.abspath(trace)), "chaos-profiles"
+    )
+    trig = timeplane.ProfilerTrigger(
+        profile_dir, seconds=0.2, cooldown_s=600.0
+    )
+    prev_trig = timeplane.set_trigger(trig)
     # No EOS on the wedge engine: an early EOS inside the first decode
     # chunk would finish the request in one tick, leaving nothing
     # pending — and stillness without pending work is (correctly) not a
@@ -636,6 +658,26 @@ def main() -> int:
         return fail("serve.stalled gauge not set on the wedged engine")
     if telemetry.counter("serve.stalls").value <= stalls_before:
         return fail("serve.stalls counter not bumped by the wedge")
+    # The stall fired the profiler trigger: exactly one capture, with a
+    # real artifact directory on disk; a second trigger inside the
+    # cooldown is suppressed, never queued.
+    if len(trig.captures) != 1:
+        return fail(
+            f"stall fired {len(trig.captures)} profiler captures "
+            "(wanted exactly 1)"
+        )
+    if not os.path.isdir(trig.captures[0]):
+        return fail(
+            f"profiler capture artifact path missing: {trig.captures[0]}"
+        )
+    if timeplane.fire_profile("stall", engine=engw.engine_id) is not None:
+        return fail(
+            "second profiler trigger inside the cooldown was NOT suppressed"
+        )
+    if trig.suppressed < 1:
+        return fail("cooldown suppression left no ops.profiles_suppressed")
+    trig.wait(10.0)  # let the bounded capture window close cleanly
+    timeplane.set_trigger(prev_trig)  # sentinel restores env-lazy state
     try:
         urllib.request.urlopen(wurl + "/healthz", timeout=10)
         return fail("/healthz returned 200 for a wedged sole engine")
@@ -721,7 +763,7 @@ def main() -> int:
     # ---------------- Trace assertions ----------------
     telemetry.emit_counters()
     plane.release()
-    spans, counters, dumps = parse_trace(trace)
+    spans, counters, dumps, events = parse_trace(trace)
     if not attr_seen["goodput"]:
         return fail(
             "no mid-soak /metrics scrape observed occupancy > 0 with "
@@ -743,6 +785,35 @@ def main() -> int:
         f"chaos_soak: ops OK — {attr_seen['scrapes']} validated /metrics "
         f"scrapes, stalls={counters.get('serve.stalls')}, "
         f"scrape_count={counters.get('ops.scrapes')}"
+    )
+    # Time plane (ISSUE 15): the wedge's stall produced EXACTLY ONE
+    # ops.profile event (rate-limited; the in-cooldown retry shows as
+    # suppressed), its artifact path exists, and the per-tick phase
+    # events the Perfetto exporter lays out are in the trace.
+    profiles = events.get("ops.profile", [])
+    if len(profiles) != 1:
+        return fail(
+            f"trace shows {len(profiles)} ops.profile events (wanted "
+            "exactly 1 — the rate limit leaked or the trigger never fired)"
+        )
+    ppath = (profiles[0].get("attrs") or {}).get("path")
+    if not ppath or not os.path.isdir(ppath):
+        return fail(f"ops.profile artifact path missing on disk: {ppath!r}")
+    if counters.get("ops.profiles_suppressed", 0) < 1:
+        return fail(
+            "trace counters show no ops.profiles_suppressed from the "
+            "in-cooldown retry"
+        )
+    if not events.get("serve.tick"):
+        return fail(
+            "trace shows no serve.tick phase events — the time plane "
+            "never published"
+        )
+    print(
+        "chaos_soak: time plane OK — 1 profiler capture "
+        f"({os.path.basename(ppath)}), "
+        f"suppressed={counters.get('ops.profiles_suppressed')}, "
+        f"tick_events={len(events.get('serve.tick', []))}"
     )
     if AUDITING:
         if counters.get("audit.checked", 0) < 1:
@@ -1081,7 +1152,7 @@ def fleet_main() -> int:
 
     # ---------------- Trace assertions ----------------
     telemetry.emit_counters()
-    spans, counters, dumps = parse_trace(trace)
+    spans, counters, dumps, _events = parse_trace(trace)
     if counters.get("serve.stalls", 0) < 1:
         return fail("trace shows no serve.stalls from the fleet wedge")
     if os.environ.get("TDX_FLIGHT_RECORDER") and "stall" not in dumps:

@@ -1,0 +1,384 @@
+#!/usr/bin/env python
+"""Perfetto / Chrome trace-event export of a telemetry JSONL trace.
+
+Merges the request-scoped event stream, the engine spans, and the time
+plane's per-tick phase segments (``serve.tick`` events,
+docs/observability.md "Time plane") into ONE timeline a flight dump or
+chaos trace opens directly in https://ui.perfetto.dev (legacy Chrome
+JSON is Perfetto's native import format):
+
+* a **track per engine tick loop** — each tick a slice, its phase
+  segments (schedule / prefill_dispatch / decode_dispatch /
+  device_wait / commit / audit_pump) nested inside, host-overhead
+  fraction in the args;
+* a **track per request** — one thread per rid, the inter-event
+  intervals sliced by phase (queue / prefill / decode / preempt /
+  failover — the same attribution ``trace_report.py`` reports) with an
+  instant marker per lifecycle event;
+* **flow arrows** linking ``req.submitted → req.admitted →
+  req.first_token`` — across failover hops, so a mid-stream failover
+  reads as one arrow chain hopping engines;
+* **host-thread tracks** for the raw telemetry spans (``serve.step``,
+  ``serve.prefill``, ``serve.recover`` ...), which nest exactly as the
+  span stack recorded them;
+* flight-dump markers as global instants.
+
+Importable (:func:`to_perfetto` / :func:`validate`) — the CI chaos jobs
+export each soak trace and validate it (every request id present,
+slices nest, flow chains resolve) before uploading the timeline as an
+artifact.  ``trace_report.py --format=perfetto`` routes here too.
+
+Usage::
+
+    python scripts/timeline_export.py trace.jsonl -o timeline.json
+    python scripts/timeline_export.py trace.jsonl --validate   # CI gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from trace_report import _STATE_AFTER, load_records  # noqa: E402
+
+__all__ = ["to_perfetto", "validate", "load_records"]
+
+PID_HOST = 1  # raw span records, one tid per recording thread
+PID_REQUESTS = 2  # one tid per request timeline
+PID_ENGINES_BASE = 100  # one pid per engine tick loop
+
+_US = 1e6  # trace-event timestamps are microseconds
+
+
+def _meta(pid: int, name: str, tid: Optional[int] = None) -> Dict[str, Any]:
+    ev = {
+        "ph": "M",
+        "name": "process_name" if tid is None else "thread_name",
+        "pid": pid,
+        "args": {"name": name},
+    }
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def _slice(
+    pid: int, tid: int, name: str, ts: float, dur: float,
+    cat: str = "tdx", args: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    ev = {
+        "ph": "X", "pid": pid, "tid": tid, "name": name, "cat": cat,
+        "ts": ts * _US, "dur": max(0.0, dur) * _US,
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def to_perfetto(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON (``{"traceEvents": [...]}``) from a
+    telemetry record stream (:func:`load_records` or the in-memory
+    collector's ``snapshot()["spans"]``)."""
+    events: List[Dict[str, Any]] = []
+    engine_pids: Dict[str, int] = {}
+    host_tids: Dict[int, int] = {}
+    req_events: Dict[str, List[Dict[str, Any]]] = {}
+
+    def engine_pid(eid: str) -> int:
+        pid = engine_pids.get(eid)
+        if pid is None:
+            pid = PID_ENGINES_BASE + len(engine_pids)
+            engine_pids[eid] = pid
+            events.append(_meta(pid, f"engine {eid}"))
+            events.append(_meta(pid, "tick loop", tid=1))
+        return pid
+
+    events.append(_meta(PID_HOST, "host threads"))
+    events.append(_meta(PID_REQUESTS, "requests"))
+
+    for rec in records:
+        kind = rec.get("type")
+        if kind == "span":
+            dur = rec.get("dur_s")
+            ts = rec.get("ts")
+            if dur is None or ts is None:
+                continue
+            thread = int(rec.get("thread") or 0)
+            tid = host_tids.get(thread)
+            if tid is None:
+                tid = host_tids[thread] = len(host_tids) + 1
+                events.append(
+                    _meta(PID_HOST, f"thread {thread}", tid=tid)
+                )
+            args: Dict[str, Any] = {}
+            for k in ("rid", "engine", "hop"):
+                if rec.get(k) is not None:
+                    args[k] = rec[k]
+            if rec.get("attrs"):
+                args.update(rec["attrs"])
+            events.append(
+                _slice(
+                    PID_HOST, tid, rec.get("name", "span"),
+                    float(ts), float(dur), cat="span", args=args or None,
+                )
+            )
+        elif kind == "flight_dump":
+            events.append({
+                "ph": "i", "s": "g", "pid": PID_HOST, "tid": 0,
+                "name": f"flight_dump:{rec.get('reason', '?')}",
+                "cat": "flight", "ts": float(rec.get("ts") or 0.0) * _US,
+            })
+        elif kind == "event":
+            name = rec.get("name", "")
+            attrs = rec.get("attrs") or {}
+            if name == "serve.tick":
+                eid = str(rec.get("engine") or attrs.get("engine") or "eng?")
+                pid = engine_pid(eid)
+                t0 = float(attrs.get("t0") or rec.get("ts") or 0.0)
+                dur = float(attrs.get("dur_s") or 0.0)
+                events.append(
+                    _slice(
+                        pid, 1, f"tick {attrs.get('tick', '?')}", t0, dur,
+                        cat="tick",
+                        args={
+                            "host_overhead_frac": attrs.get(
+                                "host_overhead_frac"
+                            ),
+                            "tick_s": attrs.get("tick_s", dur),
+                        },
+                    )
+                )
+                for seg in attrs.get("segments") or []:
+                    phase, off, seg_dur = seg[0], float(seg[1]), float(seg[2])
+                    # Clamp into the parent so float rounding can never
+                    # push a phase slice past its tick.
+                    off = max(0.0, min(off, dur))
+                    seg_dur = max(0.0, min(seg_dur, dur - off))
+                    events.append(
+                        _slice(pid, 1, phase, t0 + off, seg_dur, cat="phase")
+                    )
+            elif name.startswith("req.") and rec.get("rid") is not None:
+                req_events.setdefault(str(rec["rid"]), []).append(rec)
+
+    # Request tracks: one tid per rid, phase interval slices + instants
+    # + the submit→admit→first_token flow chain (across hops).
+    flow_id = 0
+    for idx, rid in enumerate(sorted(req_events), start=1):
+        evs = sorted(req_events[rid], key=lambda e: float(e["ts"]))
+        events.append(_meta(PID_REQUESTS, rid, tid=idx))
+        for prev, nxt in zip(evs, evs[1:]):
+            pname = prev.get("name", "")
+            if pname == "req.failed":
+                state = "failover"
+            else:
+                state = _STATE_AFTER.get(pname, "unaccounted")
+            dur = float(nxt["ts"]) - float(prev["ts"])
+            if dur <= 0:
+                continue
+            args = {"after": pname}
+            if prev.get("engine"):
+                args["engine"] = prev["engine"]
+            if prev.get("hop") is not None:
+                args["hop"] = prev["hop"]
+            events.append(
+                _slice(
+                    PID_REQUESTS, idx, state, float(prev["ts"]), dur,
+                    cat="req", args=args,
+                )
+            )
+        for ev in evs:
+            events.append({
+                "ph": "i", "s": "t", "pid": PID_REQUESTS, "tid": idx,
+                "name": ev.get("name", "event"), "cat": "req",
+                "ts": float(ev["ts"]) * _US,
+                "args": {
+                    k: ev[k] for k in ("engine", "hop") if ev.get(k) is not None
+                },
+            })
+        # The flow chain: start at the first submit, step through every
+        # admit/failover hop, finish at the LAST first_token — so a
+        # failover's re-prefill on the peer engine is one arrow chain.
+        points: List[Tuple[str, float]] = []
+        for ev in evs:
+            if ev["name"] in (
+                "req.submitted", "req.admitted", "req.failover_hop",
+                "req.first_token",
+            ):
+                points.append((ev["name"], float(ev["ts"])))
+        firsts = [i for i, (n, _) in enumerate(points) if n == "req.first_token"]
+        if points and firsts and points[0][0] == "req.submitted":
+            chain = points[: firsts[-1] + 1]
+            flow_id += 1
+            for i, (pname, ts) in enumerate(chain):
+                ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+                ev: Dict[str, Any] = {
+                    "ph": ph, "pid": PID_REQUESTS, "tid": idx,
+                    "name": "req-flow", "cat": "flow", "id": flow_id,
+                    "ts": ts * _US,
+                }
+                if ph == "f":
+                    ev["bp"] = "e"  # bind to the enclosing slice
+                events.append(ev)
+
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "torchdistx_tpu scripts/timeline_export.py",
+            "n_engines": len(engine_pids),
+            "n_requests": len(req_events),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Validation (the CI gate)
+
+
+def validate(
+    trace: Dict[str, Any], records: Optional[Iterable[Dict[str, Any]]] = None
+) -> List[str]:
+    """Structural problems of an exported timeline (empty = valid):
+
+    * every request id carrying ``req.*`` events in ``records`` (when
+      given) has a named track and at least one event on it;
+    * "X" slices NEST within each (pid, tid) — a slice starting inside
+      another ends inside it;
+    * every flow chain resolves: exactly one start and one finish per
+      id, timestamps monotone, and every flow event binds to a slice or
+      instant at its (pid, tid, ts).
+    """
+    problems: List[str] = []
+    events = trace.get("traceEvents") or []
+    eps = 1.5  # µs tolerance for float rounding
+
+    # -- request-id coverage ------------------------------------------------
+    track_names = {
+        ev["args"]["name"]
+        for ev in events
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name"
+        and ev.get("pid") == PID_REQUESTS
+    }
+    if records is not None:
+        want = {
+            str(rec["rid"])
+            for rec in records
+            if rec.get("type") == "event"
+            and str(rec.get("name", "")).startswith("req.")
+            and rec.get("rid") is not None
+        }
+        missing = want - track_names
+        if missing:
+            problems.append(
+                f"{len(missing)} request id(s) missing a timeline track: "
+                f"{sorted(missing)[:5]}"
+            )
+
+    # -- slice nesting ------------------------------------------------------
+    by_track: Dict[Tuple[Any, Any], List[Dict[str, Any]]] = {}
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_track.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    for (pid, tid), slices in by_track.items():
+        slices.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[Tuple[float, float, str]] = []  # (ts, end, name)
+        for ev in slices:
+            ts, end = ev["ts"], ev["ts"] + ev.get("dur", 0.0)
+            while stack and ts >= stack[-1][1] - eps:
+                stack.pop()
+            if stack and end > stack[-1][1] + eps:
+                problems.append(
+                    f"pid={pid} tid={tid}: slice {ev['name']!r} "
+                    f"[{ts:.1f}, {end:.1f}] escapes enclosing "
+                    f"{stack[-1][2]!r} ending {stack[-1][1]:.1f}"
+                )
+                continue
+            stack.append((ts, end, ev["name"]))
+
+    # -- flow resolution ----------------------------------------------------
+    flows: Dict[Any, List[Dict[str, Any]]] = {}
+    for ev in events:
+        if ev.get("ph") in ("s", "t", "f"):
+            flows.setdefault(ev.get("id"), []).append(ev)
+    anchors: Dict[Tuple[Any, Any], List[Tuple[float, float]]] = {}
+    for ev in events:
+        key = (ev.get("pid"), ev.get("tid"))
+        if ev.get("ph") == "X":
+            anchors.setdefault(key, []).append(
+                (ev["ts"], ev["ts"] + ev.get("dur", 0.0))
+            )
+        elif ev.get("ph") == "i":
+            anchors.setdefault(key, []).append((ev["ts"], ev["ts"]))
+    for fid, chain in flows.items():
+        chain.sort(key=lambda e: e["ts"])
+        phs = [ev["ph"] for ev in chain]
+        if phs.count("s") != 1 or phs.count("f") != 1:
+            problems.append(
+                f"flow {fid}: unresolved chain (phases {phs} — need "
+                "exactly one start and one finish)"
+            )
+            continue
+        if phs[0] != "s" or phs[-1] != "f":
+            problems.append(f"flow {fid}: start/finish out of order ({phs})")
+        for ev in chain:
+            spans = anchors.get((ev.get("pid"), ev.get("tid")), [])
+            if not any(
+                t0 - eps <= ev["ts"] <= t1 + eps for t0, t1 in spans
+            ):
+                problems.append(
+                    f"flow {fid}: {ev['ph']!r} event at ts={ev['ts']:.1f} "
+                    f"binds to no slice on pid={ev.get('pid')} "
+                    f"tid={ev.get('tid')}"
+                )
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Export a telemetry JSONL trace as a Perfetto/Chrome "
+        "trace-event timeline"
+    )
+    ap.add_argument("trace", help="JSONL trace file (TDX_TELEMETRY output)")
+    ap.add_argument(
+        "-o", "--out",
+        help="output path (default: <trace>.perfetto.json)",
+    )
+    ap.add_argument(
+        "--validate", action="store_true",
+        help="validate the exported timeline (CI gate): request-id "
+        "coverage, slice nesting, flow resolution — exit 1 on problems",
+    )
+    args = ap.parse_args(argv)
+
+    records = load_records(args.trace)
+    trace = to_perfetto(records)
+    out = args.out or (args.trace + ".perfetto.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(trace, f)
+    other = trace["otherData"]
+    print(
+        f"timeline_export: {len(trace['traceEvents'])} trace events "
+        f"({other['n_requests']} request tracks, {other['n_engines']} "
+        f"engine tick tracks) -> {out}"
+    )
+    if args.validate:
+        problems = validate(trace, records)
+        if problems:
+            print(
+                f"\ntimeline_export: INVALID ({len(problems)} problems):",
+                file=sys.stderr,
+            )
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 1
+        print("timeline_export: timeline validates (tracks, nesting, flows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

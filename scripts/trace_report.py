@@ -107,6 +107,22 @@ class RequestTimeline:
         )
 
     @property
+    def truncated(self) -> bool:
+        """Head events evicted by flight-ring wraparound: the timeline
+        has events but no ``req.submitted``.  A bounded ring (the
+        flight recorder, a capped collector) legitimately drops the
+        oldest records, so a long-lived request reconstructed from a
+        dump can lose its head — that is ring wraparound, not a
+        trace-context leak, and :meth:`TraceReport.problems` excludes
+        truncated timelines from ``--strict`` completeness accounting
+        (counted separately in the summary) — but only when the trace
+        actually contains a flight-dump window; in a full trace a
+        headless timeline is still flagged as a leak."""
+        return bool(self.events) and not any(
+            e["name"] == "req.submitted" for e in self.events
+        )
+
+    @property
     def engines(self) -> List[str]:
         """Engines that touched the request, in order of first touch."""
         seen: List[str] = []
@@ -183,7 +199,12 @@ class RequestTimeline:
         return out
 
     def problems(self, tolerance: float = 0.05) -> List[str]:
-        """Validation failures for this timeline (empty = clean)."""
+        """Validation failures for this timeline (empty = clean).
+        Truncated timelines (head evicted by ring wraparound) validate
+        vacuously — their phase attribution and completeness cannot be
+        judged without the missing head."""
+        if self.truncated:
+            return []
         out: List[str] = []
         evs = self._sorted()
         if not any(e["name"] == "req.submitted" for e in evs):
@@ -208,6 +229,7 @@ class RequestTimeline:
         return {
             "rid": self.rid,
             "outcome": self.outcome,
+            "truncated": self.truncated,
             "engines": self.engines,
             "max_hop": max(self.hops, default=0),
             "n_events": len(self.events),
@@ -230,7 +252,19 @@ class TraceReport:
     def problems(self, tolerance: float = 0.05) -> List[str]:
         out: List[str] = []
         for rid in sorted(self.requests):
-            for p in self.requests[rid].problems(tolerance):
+            tl = self.requests[rid]
+            if tl.truncated and not self.flight_dumps:
+                # Ring wraparound is only possible in a dumped ring
+                # window — and every dump carries its header marker.  A
+                # headless timeline in a trace with NO dump windows is a
+                # genuine trace-context leak (a full TDX_TELEMETRY trace
+                # never drops a head), so --strict still catches it.
+                out.append(
+                    f"{rid}: no req.submitted event (and no flight-dump "
+                    "window in the trace to explain ring truncation)"
+                )
+                continue
+            for p in tl.problems(tolerance):
                 out.append(f"{rid}: {p}")
         if self.orphan_spans:
             names = sorted({s["name"] for s in self.orphan_spans})
@@ -258,6 +292,9 @@ class TraceReport:
             "n_requests": len(self.requests),
             "outcomes": dict(sorted(outcomes.items())),
             "complete": sum(tl.complete for tl in self.requests.values()),
+            "truncated": sum(
+                tl.truncated for tl in self.requests.values()
+            ),
             "phase_totals_s": {k: round(v, 4) for k, v in totals.items()},
             "failovers": sum(h > 0 for h in hops),
             "max_hop": max(hops, default=0),
@@ -362,7 +399,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="with --strict: also fail unless the trace contains at "
         "least one flight_dump marker",
     )
+    ap.add_argument(
+        "--format", choices=("report", "perfetto"), default="report",
+        help="'perfetto': export the trace as a Chrome/Perfetto "
+        "trace-event timeline (scripts/timeline_export.py) to --json "
+        "(or <trace>.perfetto.json) instead of the text report",
+    )
     args = ap.parse_args(argv)
+
+    if args.format == "perfetto":
+        import timeline_export  # noqa: PLC0415 — sibling script
+
+        argv2 = [args.trace]
+        if args.json:
+            argv2 += ["-o", args.json]
+        if args.strict:
+            argv2.append("--validate")
+        return timeline_export.main(argv2)
 
     report = reconstruct(load_records(args.trace))
     summary = report.summary(args.tolerance)
@@ -373,6 +426,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print()
     print(f"requests:      {summary['n_requests']}")
     print(f"complete:      {summary['complete']}")
+    if summary["truncated"]:
+        print(f"truncated:     {summary['truncated']} (ring wraparound)")
     print(f"outcomes:      {summary['outcomes']}")
     print(f"phase totals:  {summary['phase_totals_s']}")
     print(

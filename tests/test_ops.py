@@ -7,6 +7,7 @@ parser here mirrors the one scripts/chaos_soak.py validates scrapes
 with, so a drift in the renderer fails both."""
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -726,6 +727,83 @@ def test_fleet_router_ops_plane(family):
         router.close()
     with pytest.raises(urllib.error.URLError):
         urllib.request.urlopen(url + "/healthz", timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# /requests bounding + /profile (ISSUE 15)
+
+
+def test_requests_endpoint_bounded_by_limit():
+    """Satellite pin: /requests returns the `limit` MOST-RECENT
+    timelines (default 256) — a long-lived engine's flight ring can
+    never produce an unbounded JSON body."""
+    telemetry.configure(collect=True, flight=True, flight_capacity=4096)
+    plane = ops.OpsPlane(0, ops.OpsConfig(watchdog=False, monitor=False))
+    plane.retain()
+    url = plane.server.url
+    try:
+        for i in range(10):
+            rid = f"r{i:03d}"
+            telemetry.event(
+                "req.submitted", rid=rid, engine="eng0", n_prompt=4
+            )
+            telemetry.event("req.finished", rid=rid, engine="eng0",
+                            n_tokens=1)
+        code, body = http_get(url + "/requests?limit=3")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["n_timelines"] == 10 and payload["limit"] == 3
+        rids = [r["rid"] for r in payload["requests"]]
+        # The 3 most-recent by last event (events were emitted in rid
+        # order, so the highest rids are the newest).
+        assert rids == ["r007", "r008", "r009"]
+        code, body = http_get(url + "/requests")
+        assert code == 200
+        payload = json.loads(body)
+        assert len(payload["requests"]) == 10  # under the 256 default
+        assert payload["limit"] == 256
+        code, _ = http_get(url + "/requests?limit=bogus")
+        assert code == 400
+        # limit=0 / negatives would unbound the body — rejected.
+        code, _ = http_get(url + "/requests?limit=0")
+        assert code == 400
+        code, _ = http_get(url + "/requests?limit=-5")
+        assert code == 400
+    finally:
+        plane.release()
+
+
+def test_profile_endpoint_fires_and_rate_limits(tmp_path):
+    from torchdistx_tpu.telemetry import timeplane
+
+    class Stub(timeplane.ProfilerTrigger):
+        def _start_profiler(self, path):
+            pass
+
+        def _stop_profiler(self):
+            pass
+
+    trig = Stub(str(tmp_path), seconds=0.01, cooldown_s=300.0)
+    prev = timeplane.set_trigger(trig)
+    plane = ops.OpsPlane(0, ops.OpsConfig(watchdog=False, monitor=False))
+    plane.retain()
+    url = plane.server.url
+    try:
+        code, body = http_get(url + "/profile?seconds=0.05")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["fired"] and os.path.isdir(payload["path"])
+        assert payload["seconds"] == 0.05
+        # Inside the cooldown: 429, suppressed — never queued.
+        code, body = http_get(url + "/profile")
+        assert code == 429 and not json.loads(body)["fired"]
+        code, _ = http_get(url + "/profile?seconds=-1")
+        assert code == 400
+        trig.wait(5.0)
+        assert len(trig.captures) == 1
+    finally:
+        plane.release()
+        timeplane.set_trigger(prev)
 
 
 def test_router_routes_around_stalled_engine(family):

@@ -1,0 +1,583 @@
+"""Time plane (ISSUE 15): tick-phase decomposition, host/device
+attribution, trigger-fired profiler capture, Perfetto timeline export.
+
+The acceptance shape: the engine tick decomposes into per-phase
+histograms that PRUNE with the engine, the host/device split is a
+gauge in [0, 1], a stall (or storm, or manual request) fires exactly
+one rate-limited profiler capture with a real artifact directory, and
+the merged span/event/tick-phase trace exports to a Perfetto timeline
+that validates (tracks, nesting, flows).  Real-``jax.profiler``
+capture runs in the slow lane; everything else stubs the profiler
+seam.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.serving import Engine, Health
+from torchdistx_tpu.telemetry import ops, perf, timeplane
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+import timeline_export  # noqa: E402
+
+ENGINE_KW = dict(
+    num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
+    handle_preemption=False,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    prev = telemetry.configure(collect=False, jsonl=None, flight=None)
+    telemetry.reset()  # also resets the timeplane trigger to env-lazy
+    ops.enable_tick_attribution(False)
+    yield
+    for plane in list(ops._PLANES.values()):
+        plane.close()
+    ops.enable_tick_attribution(False)
+    timeplane.set_trigger(None)
+    telemetry.configure(**prev)
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+class StubTrigger(timeplane.ProfilerTrigger):
+    """ProfilerTrigger with the jax seam stubbed: captures count and
+    create artifact dirs, but no real profiler starts."""
+
+    def __init__(self, tmpdir, **kw):
+        kw.setdefault("seconds", 0.01)
+        super().__init__(str(tmpdir), **kw)
+        self.started = []
+        self.stopped = 0
+
+    def _start_profiler(self, path):
+        self.started.append(path)
+
+    def _stop_profiler(self):
+        self.stopped += 1
+
+
+# ---------------------------------------------------------------------------
+# TickTimer + publish semantics
+
+
+def test_tick_timer_segments_and_totals():
+    t = timeplane.TickTimer()
+    t.begin("schedule")
+    time.sleep(0.002)
+    t.begin("decode_dispatch")
+    time.sleep(0.002)
+    t.begin("schedule")  # phases re-enter; totals accumulate
+    t.end()
+    t.end()  # idempotent
+    names = [s[0] for s in t.segments]
+    assert names == ["schedule", "decode_dispatch", "schedule"]
+    totals = t.totals()
+    assert totals["schedule"] > 0 and totals["decode_dispatch"] >= 0.002
+    # Segments are ordered and contiguous: each starts where the
+    # previous ended (offsets relative to the tick start).
+    for (_, off1, dur1), (_, off2, _) in zip(t.segments, t.segments[1:]):
+        assert off2 == pytest.approx(off1 + dur1, abs=1e-6)
+
+
+def test_engine_tick_phases_and_host_frac(family):
+    model, cfg, params = family
+    telemetry.configure(collect=True)
+    prev = ops.enable_tick_attribution(True)
+    try:
+        eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        h = eng.submit(prompt_of(4), max_new_tokens=12, key=0)
+        ticks = 0
+        while not h.done:
+            eng.step()
+            ticks += 1
+        assert h.result()
+        eid = eng.engine_id
+        hists = telemetry.histograms()
+        for phase in ("schedule", "prefill_dispatch", "decode_dispatch",
+                      "device_wait", "commit"):
+            row = hists.get(
+                f"serve.tick_phase_s{{engine={eid},phase={phase}}}"
+            )
+            assert row and row["count"] >= 1, f"phase {phase} never observed"
+        # Phases partition the tick: no phase total exceeds the ticks'
+        # total wall time.
+        tick_sum = hists[f"serve.tick_s{{engine={eid}}}"]["sum"]
+        sched = hists[f"serve.tick_phase_s{{engine={eid},phase=schedule}}"]
+        assert sched["sum"] <= tick_sum * 1.5  # tail segment may overrun
+        frac = telemetry.gauges()[f"serve.host_overhead_frac{{engine={eid}}}"]
+        assert 0.0 <= frac <= 1.0
+        # One serve.tick event per non-idle tick, carrying the ordered
+        # segments the Perfetto exporter lays out.
+        tick_events = [
+            r for r in telemetry.snapshot()["spans"]
+            if r.get("name") == "serve.tick"
+        ]
+        assert len(tick_events) == ticks
+        seg = tick_events[-1]["attrs"]["segments"]
+        assert seg and all(len(s) == 3 for s in seg)
+        assert tick_events[-1]["attrs"]["dur_s"] >= max(
+            s[1] + s[2] for s in seg
+        ) - 1e-6
+        eng.close()
+    finally:
+        ops.enable_tick_attribution(prev)
+
+
+def test_tick_phase_rows_pruned_at_finish_drain(family):
+    """Satellite pin: no serve.tick_phase_s row (and no host-overhead
+    gauge) survives _finish_drain — drain path AND close path."""
+    model, cfg, params = family
+    prev = ops.enable_tick_attribution(True)
+    try:
+        for stop in ("drain", "close"):
+            eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+            h = eng.submit(prompt_of(4), max_new_tokens=4, key=0)
+            assert h.result()
+            eid = eng.engine_id
+            assert any(
+                k.startswith(f"serve.tick_phase_s{{engine={eid}")
+                for k in telemetry.histograms()
+            )
+            if stop == "drain":
+                eng.begin_drain()
+                while eng.health() is not Health.STOPPED:
+                    eng.step()
+            else:
+                eng.close()
+            assert not any(
+                k.startswith(f"serve.tick_phase_s{{engine={eid}")
+                for k in telemetry.histograms()
+            ), f"tick-phase rows survived {stop}"
+            assert (
+                f"serve.host_overhead_frac{{engine={eid}}}"
+                not in telemetry.gauges()
+            )
+    finally:
+        ops.enable_tick_attribution(prev)
+
+
+def test_disabled_path_builds_no_timer(family):
+    model, cfg, params = family
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    try:
+        h = eng.submit(prompt_of(4), max_new_tokens=4, key=0)
+        assert h.result()
+        assert eng._tp_state is None and eng._tick_timer is None
+        assert not any(
+            k.startswith("serve.tick_phase_s") for k in telemetry.histograms()
+        )
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Histogram concurrency (satellite): multi-thread observe vs
+# bucket_counts() snapshot exactness under the new phase families.
+
+
+def test_histogram_concurrent_observe_snapshot_exact():
+    h = telemetry.histogram(
+        "serve.tick_phase_s", engine="hx", phase="decode_dispatch"
+    )
+    N, T = 2000, 4
+    stop = threading.Event()
+    snapshots = []
+
+    def observer(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(N):
+            h.observe(float(rng.uniform(1e-4, 1.0)))
+
+    def scraper():
+        while not stop.is_set():
+            bounds, cum, total, s = h.bucket_counts()
+            snapshots.append((cum[-1], total))
+
+    threads = [threading.Thread(target=observer, args=(i,)) for i in range(T)]
+    sc = threading.Thread(target=scraper)
+    sc.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    sc.join()
+    # Every mid-run snapshot held the Prometheus invariant exactly
+    # (+Inf cumulative == count), and the final counts are exact.
+    assert snapshots and all(c == t for c, t in snapshots)
+    bounds, cum, total, s = h.bucket_counts()
+    assert total == N * T and cum[-1] == total
+    assert h.count == N * T
+    telemetry.remove("serve.tick_phase_s", engine="hx", phase="decode_dispatch")
+
+
+# ---------------------------------------------------------------------------
+# ProfilerTrigger: rate limit, events, artifact paths, wiring
+
+
+def test_trigger_fires_once_then_suppresses(tmp_path):
+    telemetry.configure(collect=True)
+    trig = StubTrigger(tmp_path, cooldown_s=300.0)
+    path = trig.fire("stall", engine="eng0")
+    assert path is not None and os.path.isdir(path)
+    assert "stall" in os.path.basename(path)
+    # Inside the cooldown: suppressed, never queued.
+    assert trig.fire("stall", engine="eng0") is None
+    trig.wait(5.0)
+    assert trig.fire("slo_burn") is None  # still cooling down
+    assert trig.captures == [path] and trig.suppressed == 2
+    recs = telemetry.snapshot()["spans"]
+    profiles = [r for r in recs if r.get("name") == "ops.profile"]
+    suppressed = [
+        r for r in recs if r.get("name") == "ops.profile_suppressed"
+    ]
+    assert len(profiles) == 1 and len(suppressed) == 2
+    assert profiles[0]["attrs"]["path"] == path
+    assert profiles[0]["attrs"]["reason"] == "stall"
+    assert trig.started == [path] and trig.stopped == 1
+
+
+def test_trigger_refires_after_cooldown(tmp_path):
+    trig = StubTrigger(tmp_path, cooldown_s=0.0)
+    p1 = trig.fire("a")
+    trig.wait(5.0)
+    p2 = trig.fire("b")
+    trig.wait(5.0)
+    assert p1 and p2 and p1 != p2
+    assert len(trig.captures) == 2
+
+
+def test_fire_profile_noop_without_trigger():
+    assert timeplane.get_trigger() is None  # env unset in tests
+    assert timeplane.fire_profile("stall") is None
+    assert telemetry.counters().get("ops.profiles", 0) == 0
+
+
+def test_default_trigger_is_manual_only():
+    """The /profile endpoint's temp-dir default must not arm AUTOMATIC
+    capture: fire_profile (the stall/burn/storm/slow-tick funnel)
+    skips it; a real (env / set_trigger) trigger is not manual-only."""
+    trig = timeplane.get_trigger(create_default=True)
+    assert trig is not None and trig.manual_only
+    assert timeplane.fire_profile("stall") is None  # automatic: skipped
+    assert trig.captures == []
+    trig.seconds = 0.01  # stub the seam: no real capture in tier-1
+    trig._start_profiler = lambda path: None
+    trig._stop_profiler = lambda: None
+    assert trig.fire("manual") is not None  # on-demand still works
+    trig.wait(5.0)
+
+
+def test_slow_tick_skips_manual_only_trigger(tmp_path, family):
+    """The slow-tick outlier is an AUTOMATIC path: it must not fire the
+    /profile endpoint's manual-only default trigger."""
+    model, cfg, params = family
+    trig = StubTrigger(tmp_path, cooldown_s=0.0)
+    trig.manual_only = True
+    timeplane.set_trigger(trig)
+    prev = ops.enable_tick_attribution(True)
+    try:
+        eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        for _ in range(timeplane._SLOW_TICK_MIN_TICKS):
+            eng._tick_telemetry(0.001, 0, 1, 0)
+        timer = timeplane.TickTimer()
+        timer.begin("schedule")
+        timer.end()
+        timeplane.publish_tick(eng, timer, tick_s=1.0)
+        assert trig.captures == []  # outlier detected, capture skipped
+        eng.close()
+    finally:
+        ops.enable_tick_attribution(prev)
+
+
+def test_failed_capture_dir_does_not_arm_cooldown(tmp_path):
+    """A capture whose artifact dir cannot be created must roll the
+    cooldown back — the NEXT incident still gets its profile — and say
+    so (ops.profile_failed), never silently."""
+    telemetry.configure(collect=True)
+    blocker = tmp_path / "blocked"
+    blocker.write_text("")  # a FILE where the log dir should be
+    trig = StubTrigger(blocker / "sub", cooldown_s=300.0)
+    assert trig.fire("stall") is None
+    recs = telemetry.snapshot()["spans"]
+    assert any(r.get("name") == "ops.profile_failed" for r in recs)
+    assert not any(r.get("name") == "ops.profile" for r in recs)
+    # The cooldown was NOT armed: a working trigger state fires now.
+    trig.log_dir = str(tmp_path / "ok")
+    path = trig.fire("stall")
+    assert path is not None and os.path.isdir(path)
+    trig.wait(5.0)
+
+
+def test_env_seeded_trigger(tmp_path, monkeypatch):
+    monkeypatch.setenv("TDX_PROFILE_DIR", str(tmp_path))
+    monkeypatch.setenv("TDX_PROFILE_SECONDS", "0.5")
+    monkeypatch.setenv("TDX_PROFILE_COOLDOWN_S", "7")
+    telemetry.reset()  # drop the fixture's cached None
+    trig = timeplane.get_trigger()
+    assert trig is not None
+    assert trig.log_dir == str(tmp_path)
+    assert trig.seconds == 0.5 and trig.cooldown_s == 7.0
+
+
+class _FakeEngine:
+    def __init__(self, eid="tp0"):
+        self.engine_id = eid
+        self._tick_no = 0
+        self._decode_tokens = 0
+        self._prefill_no = 0
+        self.scheduler = [1]
+
+    def health(self):
+        return Health.READY
+
+    def _n_running(self):
+        return 0
+
+    def _mark_stalled(self):
+        pass
+
+
+def test_watchdog_stall_fires_trigger(tmp_path):
+    telemetry.configure(collect=True, flight=True)
+    trig = StubTrigger(tmp_path, cooldown_s=300.0)
+    timeplane.set_trigger(trig)
+    eng = _FakeEngine()
+    wd = ops.StallWatchdog(eng, deadline_s=0.05, poll_s=0.01)
+    wd.start()
+    try:
+        t0 = time.monotonic()
+        while not trig.captures and time.monotonic() - t0 < 5.0:
+            time.sleep(0.01)
+        assert len(trig.captures) == 1
+        assert os.path.isdir(trig.captures[0])
+        recs = telemetry.snapshot()["spans"]
+        prof = [r for r in recs if r.get("name") == "ops.profile"]
+        assert prof and prof[0]["attrs"]["reason"] == "stall"
+        assert prof[0].get("engine") == "tp0"
+    finally:
+        wd.stop()
+
+
+def test_recompile_storm_fires_trigger(tmp_path):
+    telemetry.configure(collect=True, flight=True)
+    trig = StubTrigger(tmp_path, cooldown_s=300.0)
+    timeplane.set_trigger(trig)
+    prev = perf.storm_config(threshold=2, window_s=60.0)
+    try:
+        owner = _FakeEngine("storm0")
+        for _ in range(3):  # first compile + 2 recompiles → storm
+            perf.record_compile("prog_x", 0.01, owner=owner, track=True)
+        assert len(trig.captures) == 1
+        recs = telemetry.snapshot()["spans"]
+        prof = [r for r in recs if r.get("name") == "ops.profile"]
+        assert prof and prof[0]["attrs"]["reason"] == "recompile_storm"
+    finally:
+        perf.storm_config(*prev)
+
+
+def test_slow_tick_outlier_fires_trigger(tmp_path, family):
+    """A tick far past the engine's own p50 fires ONE capture (k from
+    TDX_SLOW_TICK_K; needs real tick history first)."""
+    model, cfg, params = family
+    trig = StubTrigger(tmp_path, cooldown_s=300.0)
+    timeplane.set_trigger(trig)
+    prev = ops.enable_tick_attribution(True)
+    try:
+        eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        # Feed the tick histogram a tight baseline past the minimum.
+        for _ in range(timeplane._SLOW_TICK_MIN_TICKS):
+            eng._tick_telemetry(0.001, 0, 1, 0)
+        timer = timeplane.TickTimer()
+        timer.begin("schedule")
+        timer.end()
+        timeplane.publish_tick(eng, timer, tick_s=1.0)  # 1000× the p50
+        assert len(trig.captures) == 1
+        assert "slow_tick" in os.path.basename(trig.captures[0])
+        # A second outlier inside the cooldown is suppressed.
+        timeplane.publish_tick(eng, timer, tick_s=1.0)
+        assert len(trig.captures) == 1 and trig.suppressed >= 1
+        eng.close()
+    finally:
+        ops.enable_tick_attribution(prev)
+
+
+@pytest.mark.slow
+def test_real_jax_profiler_capture_e2e(tmp_path, family):
+    """The real seam: jax.profiler start/stop around live device work —
+    the capture window must produce a non-empty artifact directory."""
+    model, cfg, params = family
+    trig = timeplane.ProfilerTrigger(
+        str(tmp_path), seconds=0.5, cooldown_s=0.0
+    )
+    timeplane.set_trigger(trig)
+    eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+    try:
+        path = timeplane.fire_profile("manual")
+        assert path is not None
+        h = eng.submit(prompt_of(4), max_new_tokens=8, key=0)
+        assert h.result()  # device work inside the capture window
+        trig.wait(30.0)
+        assert os.path.isdir(path)
+        captured = [
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(path)
+            for f in fs
+        ]
+        assert captured, "profiler capture produced no artifact files"
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+
+
+def _ev(name, ts, rid="r0", hop=0, engine="eng0", **attrs):
+    rec = {
+        "type": "event", "name": name, "ts": ts, "rid": rid, "hop": hop,
+        "engine": engine,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def test_perfetto_synthetic_failover_flows():
+    recs = [
+        _ev("req.submitted", 0.0, engine="fleet"),
+        _ev("req.admitted", 1.0, engine="eng0"),
+        _ev("req.failed", 2.0, engine="eng0", error="RequestPreempted",
+            retryable=True),
+        _ev("req.failover_hop", 2.5, engine="eng1", hop=1),
+        _ev("req.admitted", 3.0, engine="eng1", hop=1),
+        _ev("req.first_token", 3.5, engine="eng1", hop=1, ttft_s=3.5),
+        _ev("req.finished", 4.0, engine="eng1", hop=1, n_tokens=8),
+        {
+            "type": "event", "name": "serve.tick", "engine": "eng1",
+            "ts": 3.6, "attrs": {
+                "tick": 7, "t0": 3.0, "dur_s": 0.6, "tick_s": 0.59,
+                "host_overhead_frac": 0.4,
+                "segments": [
+                    ["schedule", 0.0, 0.1],
+                    ["decode_dispatch", 0.1, 0.2],
+                    ["device_wait", 0.3, 0.2],
+                    ["commit", 0.5, 0.1],
+                ],
+            },
+        },
+        {"type": "span", "name": "serve.step", "ts": 3.05, "dur_s": 0.4,
+         "thread": 1, "depth": 0},
+        {"type": "flight_dump", "ts": 2.1, "reason": "stall", "n": 3},
+    ]
+    trace = timeline_export.to_perfetto(recs)
+    assert timeline_export.validate(trace, recs) == []
+    evs = trace["traceEvents"]
+    # The request got a named track and a resolved flow chain across
+    # the hop: one start, steps, one finish.
+    names = {
+        e["args"]["name"] for e in evs
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+        and e["pid"] == timeline_export.PID_REQUESTS
+    }
+    assert "r0" in names
+    flows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+    assert [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])] == [
+        "s", "t", "t", "t", "f"
+    ]
+    # The tick track carries the phase children inside the tick slice.
+    ticks = [e for e in evs if e.get("cat") == "tick"]
+    phases = [e for e in evs if e.get("cat") == "phase"]
+    assert len(ticks) == 1 and len(phases) == 4
+    t0, t1 = ticks[0]["ts"], ticks[0]["ts"] + ticks[0]["dur"]
+    for ph in phases:
+        assert t0 - 1 <= ph["ts"] and ph["ts"] + ph["dur"] <= t1 + 1
+    # The failover gap renders as a failover slice on the request track.
+    assert any(
+        e.get("ph") == "X" and e.get("name") == "failover" for e in evs
+    )
+
+
+def test_perfetto_validation_catches_broken_flow_and_nesting():
+    base = [
+        _ev("req.submitted", 0.0),
+        _ev("req.first_token", 1.0, ttft_s=1.0),
+        _ev("req.finished", 2.0, n_tokens=4),
+    ]
+    trace = timeline_export.to_perfetto(base)
+    assert timeline_export.validate(trace, base) == []
+    # Break the flow: drop its finish.
+    broken = dict(trace)
+    broken["traceEvents"] = [
+        e for e in trace["traceEvents"] if e.get("ph") != "f"
+    ]
+    assert any(
+        "unresolved" in p for p in timeline_export.validate(broken, base)
+    )
+    # A slice escaping its parent is caught.
+    bad = dict(trace)
+    bad["traceEvents"] = trace["traceEvents"] + [
+        {"ph": "X", "pid": 77, "tid": 1, "name": "outer", "cat": "t",
+         "ts": 0.0, "dur": 10.0},
+        {"ph": "X", "pid": 77, "tid": 1, "name": "escapes", "cat": "t",
+         "ts": 5.0, "dur": 10.0},
+    ]
+    assert any("escapes" in p for p in timeline_export.validate(bad, base))
+    # A request id with events but no track is caught.
+    assert any(
+        "missing a timeline track" in p
+        for p in timeline_export.validate(
+            trace, base + [_ev("req.submitted", 0.0, rid="ghost")]
+        )
+    )
+
+
+def test_perfetto_engine_e2e(family):
+    """A live engine run (ops attribution on, collector on) exports to
+    a timeline that validates: request tracks, tick track with nested
+    phases, flows resolved."""
+    model, cfg, params = family
+    telemetry.configure(collect=True, max_spans=100_000)
+    prev = ops.enable_tick_attribution(True)
+    try:
+        eng = Engine(params, model=model, cfg=cfg, **ENGINE_KW)
+        handles = [
+            eng.submit(prompt_of(4 + i), max_new_tokens=6, key=i)
+            for i in range(3)
+        ]
+        for h in handles:
+            assert h.result()
+        eng.close()
+        records = telemetry.snapshot()["spans"]
+        trace = timeline_export.to_perfetto(records)
+        assert timeline_export.validate(trace, records) == []
+        assert trace["otherData"]["n_requests"] == 3
+        assert trace["otherData"]["n_engines"] == 1
+    finally:
+        ops.enable_tick_attribution(prev)

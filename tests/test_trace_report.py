@@ -137,6 +137,51 @@ def test_incomplete_and_orphans_flagged():
     assert rep.requests["r0"].outcome == "incomplete"
 
 
+def test_ring_wrap_truncated_excluded_from_strict(tmp_path):
+    """ISSUE 15 satellite: a request whose HEAD events were evicted by
+    flight-ring wraparound is flagged `truncated` and excluded from
+    --strict completeness accounting; a genuinely incomplete
+    (submitted, never terminal) timeline still fails — and in a trace
+    with NO dump window a headless timeline is still a leak."""
+    from trace_report import load_records
+
+    dump = str(tmp_path / "flight.jsonl")
+    telemetry.configure(flight=dump, flight_capacity=6, collect=False)
+    # An old request emits its head, then enough younger traffic wraps
+    # the 6-record ring past it; only its tail survives the dump.
+    telemetry.event("req.submitted", rid="old", engine="eng0", n_prompt=4)
+    telemetry.event("req.admitted", rid="old", engine="eng0")
+    for i in range(4):
+        telemetry.event("req.submitted", rid=f"new{i}", engine="eng0")
+    telemetry.event("req.first_token", rid="old", engine="eng0", ttft_s=0.1)
+    telemetry.event("req.finished", rid="old", engine="eng0", n_tokens=8)
+    assert telemetry.flight_dump("test_wrap") == 6
+    records = load_records(dump)
+    assert not any(
+        r.get("name") == "req.submitted" and r.get("rid") == "old"
+        for r in records
+    ), "ring did not wrap past the head"
+    rep = reconstruct(records)
+    tl = rep.requests["old"]
+    assert tl.truncated and not tl.complete
+    assert tl.problems() == []  # excluded from strict accounting
+    assert tl.summary()["truncated"] is True
+    assert rep.summary()["truncated"] == 1
+    assert not any("old" in p for p in rep.problems())
+    # The wrapped-in new requests have heads but no terminals: those
+    # are genuinely incomplete, not truncated — still flagged.
+    assert not rep.requests["new0"].truncated
+    assert any("incomplete" in p for p in rep.problems())
+    # No dump window in the stream → a headless timeline is a genuine
+    # trace-context leak, and strict still catches it.
+    leak = reconstruct([
+        _ev("req.first_token", 1.0, rid="leak"),
+        _ev("req.finished", 2.0, rid="leak", n_tokens=4),
+    ])
+    assert leak.requests["leak"].truncated
+    assert any("no req.submitted" in p for p in leak.problems())
+
+
 # ---------------------------------------------------------------------------
 # Engine integration: preempted-then-resumed is ONE contiguous timeline
 

@@ -401,36 +401,45 @@ def forward_paged(params, tokens, cfg: GPT2Config, cache, block_tables,
         block_tables, pos_ids, cache["k"].shape[2]
     )
 
+    # attn/mlp named_scope regions for profiler attribution (see
+    # llama.forward_paged) — HLO metadata only, values unchanged.
     def block(carry, layer):
         x, kc, vc = carry
         lp, i = layer
-        h = _layernorm(x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps)
-        qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"]["bias"].astype(
-            cfg.dtype
-        )
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
-        v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
-        kc = kc.at[i, blk, off].set(k)
-        vc = vc.at[i, blk, off].set(v)
-        attn = paged_attention(
-            q,
-            jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
-            jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
-            block_tables,
-            positions,
-        ).reshape(b, t, -1)
-        x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
-            "bias"
-        ].astype(cfg.dtype)
-        h = _layernorm(x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.norm_eps)
-        h = jax.nn.gelu(
-            h @ lp["mlp_fc"]["weight"] + lp["mlp_fc"]["bias"].astype(cfg.dtype)
-        )
-        x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"]["bias"].astype(
-            cfg.dtype
-        )
+        with jax.named_scope("attn"):
+            h = _layernorm(
+                x, lp["ln_1"]["scale"], lp["ln_1"]["bias"], cfg.norm_eps
+            )
+            qkv = h @ lp["attn_qkv"]["weight"] + lp["attn_qkv"][
+                "bias"
+            ].astype(cfg.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+            k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
+            v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
+            kc = kc.at[i, blk, off].set(k)
+            vc = vc.at[i, blk, off].set(v)
+            attn = paged_attention(
+                q,
+                jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
+                block_tables,
+                positions,
+            ).reshape(b, t, -1)
+            x = x + attn @ lp["attn_proj"]["weight"] + lp["attn_proj"][
+                "bias"
+            ].astype(cfg.dtype)
+        with jax.named_scope("mlp"):
+            h = _layernorm(
+                x, lp["ln_2"]["scale"], lp["ln_2"]["bias"], cfg.norm_eps
+            )
+            h = jax.nn.gelu(
+                h @ lp["mlp_fc"]["weight"]
+                + lp["mlp_fc"]["bias"].astype(cfg.dtype)
+            )
+            x = x + h @ lp["mlp_proj"]["weight"] + lp["mlp_proj"][
+                "bias"
+            ].astype(cfg.dtype)
         return (x, kc, vc), None
 
     (x, new_k, new_v), _ = jax.lax.scan(

@@ -628,34 +628,42 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache, block_tables,
         block_tables, pos_bt, cache["k"].shape[2]
     )
 
+    # jax.named_scope regions (attn/mlp) label the HLO so a profiler
+    # capture (telemetry.timeplane, docs/observability.md "Time plane")
+    # attributes device time to model regions — metadata only, the
+    # compiled computation (and token identity) is unchanged.
     def block(carry, layer):
         x, kc, vc = carry
         lp, i = layer
-        h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        qkv = h @ lp["wqkv"]
-        q = qkv[..., :n_q].reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = qkv[..., n_q:n_q + n_kv].reshape(
-            b, t, cfg.n_kv_heads, cfg.head_dim
-        )
-        v = qkv[..., n_q + n_kv:].reshape(
-            b, t, cfg.n_kv_heads, cfg.head_dim
-        )
-        q = _rope_apply(q, cos, sin)
-        k = _rope_apply(k, cos, sin)
-        kc = kc.at[i, blk, off].set(k)
-        vc = vc.at[i, blk, off].set(v)
-        attn = paged_attention(
-            q,
-            jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
-            jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
-            block_tables,
-            positions,
-        )
-        x = x + attn.reshape(b, t, -1) @ lp["wo"]
-        h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gu = h @ lp["wgu"]
-        gated = jax.nn.silu(gu[..., : cfg.ffn_dim]) * gu[..., cfg.ffn_dim:]
-        x = x + gated @ lp["w_down"]
+        with jax.named_scope("attn"):
+            h = _rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+            qkv = h @ lp["wqkv"]
+            q = qkv[..., :n_q].reshape(b, t, cfg.n_heads, cfg.head_dim)
+            k = qkv[..., n_q:n_q + n_kv].reshape(
+                b, t, cfg.n_kv_heads, cfg.head_dim
+            )
+            v = qkv[..., n_q + n_kv:].reshape(
+                b, t, cfg.n_kv_heads, cfg.head_dim
+            )
+            q = _rope_apply(q, cos, sin)
+            k = _rope_apply(k, cos, sin)
+            kc = kc.at[i, blk, off].set(k)
+            vc = vc.at[i, blk, off].set(v)
+            attn = paged_attention(
+                q,
+                jax.lax.dynamic_index_in_dim(kc, i, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(vc, i, 0, keepdims=False),
+                block_tables,
+                positions,
+            )
+            x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        with jax.named_scope("mlp"):
+            h = _rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+            gu = h @ lp["wgu"]
+            gated = (
+                jax.nn.silu(gu[..., : cfg.ffn_dim]) * gu[..., cfg.ffn_dim:]
+            )
+            x = x + gated @ lp["w_down"]
         return (x, kc, vc), None
 
     (x, new_k, new_v), _ = jax.lax.scan(

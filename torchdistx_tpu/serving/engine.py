@@ -93,8 +93,12 @@ latency distributions (queue wait, prefill, TTFT, per-token decode,
 preemption outage) land in per-engine labeled telemetry histograms —
 ``stats()`` reads its percentiles from them — and the crash-recovery
 supervisor dumps the telemetry flight recorder before every replay
-pass.  All of it is free when nothing records: no events, no trace-id
-formatting, no record dicts.
+pass.  With the ops plane attached the tick itself decomposes under
+the time plane (``serve.tick_phase_s{phase=}`` histograms + the
+``serve.host_overhead_frac`` host/device split, and a rate-limited
+profiler capture when the watchdog/monitor/storm detector fires — see
+:mod:`torchdistx_tpu.telemetry.timeplane`).  All of it is free when
+nothing records: no events, no trace-id formatting, no record dicts.
 
 Fault sites (``TDX_FAULT``): ``serve.admit`` and ``serve.prefill`` —
 ``io``/``nan`` requeue at the FIFO head and the next tick retries;
@@ -125,6 +129,7 @@ from .. import telemetry as _telemetry
 from ..telemetry import audit as _audit
 from ..telemetry import ops as _ops
 from ..telemetry import perf as _perf
+from ..telemetry import timeplane as _timeplane
 from ..models.generate import _sample
 from ..resilience import faults
 from ..resilience import preemption as _preemption
@@ -238,9 +243,10 @@ def _prefill_chunk_last(
     last = jax.lax.dynamic_index_in_dim(
         logits, last_idx, axis=1, keepdims=False
     )
-    first = _sample(
-        last, jax.random.fold_in(key, 0), temperature, top_k
-    ).astype(jnp.int32)[0]
+    with jax.named_scope("sample"):
+        first = _sample(
+            last, jax.random.fold_in(key, 0), temperature, top_k
+        ).astype(jnp.int32)[0]
     return first, paged
 
 
@@ -265,10 +271,11 @@ def _decode_chunk(
         logits, cache = model.forward_paged(
             params, tok[:, None], cfg, cache, block_tables, pos
         )
-        step_keys = jax.vmap(jax.random.fold_in)(keys, n)
-        nxt = jax.vmap(
-            lambda lg, k: _sample(lg[None], k, temperature, top_k)[0]
-        )(logits[:, -1], step_keys).astype(jnp.int32)
+        with jax.named_scope("sample"):
+            step_keys = jax.vmap(jax.random.fold_in)(keys, n)
+            nxt = jax.vmap(
+                lambda lg, k: _sample(lg[None], k, temperature, top_k)[0]
+            )(logits[:, -1], step_keys).astype(jnp.int32)
         if eos_id is not None:
             nxt = jnp.where(dn, eos_id, nxt)
             dn = dn | (nxt == eos_id)
@@ -661,6 +668,12 @@ class Engine:
         self._tick_no = 0
         self._was_idle = False  # last tick's idleness (gauge-zeroing edge)
         self._g_occupancy = None  # per-tick gauges, minted on first use
+        # Time plane (docs/observability.md, "Time plane"): the per-tick
+        # phase timer (live only inside step(), only with the ops plane
+        # or forced attribution on) and its lazily minted histogram
+        # family, both owned by telemetry.timeplane.
+        self._tick_timer: Optional[_timeplane.TickTimer] = None
+        self._tp_state = None
         self._ops_plane: Optional[_ops.OpsPlane] = None
         if ops_port is None:
             ops_port = _ops.env_ops_port()
@@ -1017,10 +1030,17 @@ class Engine:
         # Ops-plane gate, read once per tick: one attribute read + one
         # module-global read — the whole cost of the disabled path.
         ops_on = self._ops_plane is not None or _ops._TICK_ATTRIBUTION
+        # Time-plane phase timer, same gate: a handful of perf_counter
+        # marks per tick when on, nothing at all when off.
+        timer = self._tick_timer = (
+            _timeplane.TickTimer(t0) if ops_on else None
+        )
         churn0 = (
             self._n_preempt_swap + self._n_preempt_replay
             + self._n_recoveries
         ) if ops_on else 0
+        if timer is not None:
+            timer.begin("schedule")
         if self._health is not Health.DRAINING and _preemption.requested():
             self._begin_drain()
         self._preempted_this_tick = False
@@ -1030,7 +1050,11 @@ class Engine:
             # tick at most, and only when no user work waits (the pump
             # checks) — before _admit_phase so a submitted audit admits
             # this same tick on an otherwise idle engine.
+            if timer is not None:
+                timer.begin("audit_pump")
             self._auditor.pump()
+            if timer is not None:
+                timer.begin("schedule")
         if self._health is not Health.DRAINING:
             self._admit_phase()
         # Swapped slots resume even while DRAINING — they are in-flight
@@ -1041,8 +1065,12 @@ class Engine:
             self._swap_in_phase()
         # Chunks advance even while DRAINING: a slot mid-prefill is
         # in-flight work the drain contract promises to finish.
+        if timer is not None:
+            timer.begin("prefill_dispatch")
         chunks = self._advance_prefills()
         committed = self._decode_phase()
+        if timer is not None:
+            timer.begin("schedule")
         if self._health is Health.DRAINING:
             self._drain_tick()
         elif self._health is Health.STARTING:
@@ -1082,6 +1110,15 @@ class Engine:
                 self._g_goodput.set(0)
         elif ops_on:
             self._tick_telemetry(tick_s, chunks, committed, churn0)
+        if timer is not None:
+            timer.end()
+            self._tick_timer = None
+            # A drain-completing tick must not re-mint the rows
+            # _finish_drain just pruned — a stopped engine leaves no
+            # time-plane readings behind (same rule as the routing
+            # gauges below).
+            if self._health is not Health.STOPPED:
+                _timeplane.publish_tick(self, timer, tick_s, idle=idle)
         self._was_idle = idle
         # A tick that completed the drain must not re-write the routing
         # gauges _finish_drain just cleared — a stopped engine leaves no
@@ -1372,6 +1409,12 @@ class Engine:
         # The divergence latch gauge is a dynamic label family: prune it
         # with the engine (the flag itself survives for introspection).
         _telemetry.remove("serve.diverging", engine=self.engine_id)
+        # Time-plane teardown: the tick-phase histogram family and the
+        # host-overhead gauge leave the registry with the engine — no
+        # serve.tick_phase_s row survives a drain (bounded cardinality
+        # under replica churn, same rule as serve.stalled).
+        self._tp_state = None
+        _timeplane.prune_engine(self.engine_id)
         # HBM ledger teardown: a stopped engine's pool/swap/prefix
         # accounts leave the ledger; weights leave when the LAST engine
         # sharing the params pytree stops (peers may still serve it).
@@ -1950,7 +1993,17 @@ class Engine:
                 model=self.model, cfg=self.cfg,
                 temperature=self.temperature, top_k=self.top_k,
             )
-            return int(first)
+            tt = self._tick_timer
+            if tt is not None:
+                # The int() below is the prefill-side host sync (the
+                # sampled token materializes here): count it as
+                # device_wait, or a prefill-bound tick would read as
+                # host-bound on serve.host_overhead_frac.
+                tt.begin("device_wait")
+            first = int(first)
+            if tt is not None:
+                tt.begin("prefill_dispatch")
+            return first
         self._cache = _JP_PREFILL.call(
             self, f"prefill_chunk:b{bucket}",
             self._params, self._cache, tokens, pos, table,
@@ -2180,6 +2233,9 @@ class Engine:
         # auditor must catch (nothing else will: the device state keeps
         # the true token, so the stream stays plausible).
         corrupt = kind == "corrupt"
+        tt = self._tick_timer
+        if tt is not None:
+            tt.begin("decode_dispatch")
         sp = _telemetry.start_span(
             "serve.step",
             n_active=self._n_decoding(),
@@ -2220,7 +2276,14 @@ class Engine:
             self._consec_decode_failures = 0
             self._supervise_recovery(err)
             return 0
+        if tt is not None:
+            # The dispatch gap: everything after here until the asarray
+            # returns is the host blocked on device compute — the
+            # device side of serve.host_overhead_frac.
+            tt.begin("device_wait")
         out = np.asarray(out)  # (chunk, S) — the one host sync per chunk
+        if tt is not None:
+            tt.begin("commit")
         if corrupt:
             out = out.copy()  # the jax-backed view may be read-only
             for slot in range(self.num_slots):

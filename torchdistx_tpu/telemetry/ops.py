@@ -27,7 +27,12 @@ pieces, all opt-in (``Engine(ops_port=...)`` / ``FleetRouter(ops_port=
     rebuilt in-process by ``scripts/trace_report.reconstruct()`` over
     the live flight-recorder ring (or the in-memory collector when the
     ring is off) — "where is request X right now" without killing the
-    process.
+    process.  Bounded: the ``?limit=`` most-recent timelines (default
+    256), so a long-lived engine can never return an unbounded body.
+  - ``/profile?seconds=N``: on-demand bounded profiler capture through
+    the time plane's rate-limited trigger
+    (:mod:`torchdistx_tpu.telemetry.timeplane`) — 200 with the artifact
+    path, 429 when the cooldown suppressed it.
 
 * **Per-tick utilization attribution** — the engine tick loop (gated on
   this plane being attached, or :func:`enable_tick_attribution`)
@@ -92,8 +97,10 @@ import time
 from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 from . import _core
+from . import timeplane as _timeplane
 
 __all__ = [
     "OpsConfig",
@@ -565,8 +572,12 @@ class SLOMonitor:
     @staticmethod
     def _default_on_burn(tenant: str, info: Dict[str, Any]) -> None:
         # The post-mortem moment the flight recorder exists for: the
-        # ring holds the requests that burned the budget.
+        # ring holds the requests that burned the budget — and, with a
+        # profiler trigger installed (docs/observability.md, "Time
+        # plane"), a bounded device profile of the burning window rides
+        # along.
         _core.flight_dump("slo_burn", tenant=tenant, **info)
+        _timeplane.fire_profile("slo_burn", tenant=tenant)
 
     def _drop_tenant(self, tenant: str) -> None:
         self._events.pop(tenant, None)
@@ -710,6 +721,10 @@ class StallWatchdog(threading.Thread):
         _core.flight_dump(
             "stall", engine=eid, pending=pending, deadline_s=self.deadline_s
         )
+        # Trigger-fired profiler capture (rate-limited; no-op with no
+        # trigger installed): the stall's flight dump comes with a
+        # bounded device profile of the wedged window.
+        _timeplane.fire_profile("stall", engine=eid, pending=pending)
         try:
             self.engine._mark_stalled()
         except Exception:  # noqa: BLE001 — a dying engine is already routed out
@@ -870,7 +885,7 @@ class OpsPlane:
             {"status": "ok" if ready else "unavailable", "engines": states},
         )
 
-    def _requests(self) -> Tuple[int, Dict[str, Any]]:
+    def _requests(self, limit: int = 256) -> Tuple[int, Dict[str, Any]]:
         reconstruct = _load_reconstruct()
         if reconstruct is None:
             return 503, {
@@ -883,14 +898,45 @@ class OpsPlane:
             records = list(_core._state.spans)
             source = "collector"
         report = reconstruct(records)
+        # Bounded response: the `limit` MOST-RECENT timelines (by last
+        # event timestamp), so a long-lived engine's flight ring can
+        # never produce an unbounded JSON body.  `?limit=N` overrides;
+        # `n_timelines` is the unbounded count for the caller to page.
+        def last_ts(rid: str) -> float:
+            return max(
+                (float(e.get("ts") or 0.0)
+                 for e in report.requests[rid].events),
+                default=0.0,
+            )
+
+        rids = sorted(report.requests, key=lambda r: (last_ts(r), r))
+        if limit > 0:
+            rids = rids[-limit:]
         return 200, {
             "source": source,
             "n_records": len(records),
+            "n_timelines": len(report.requests),
+            "limit": limit,
             "requests": [
-                report.requests[rid].summary()
-                for rid in sorted(report.requests)
+                report.requests[rid].summary() for rid in sorted(rids)
             ],
         }
+
+    def _profile(self, seconds: Optional[float]) -> Tuple[int, Dict[str, Any]]:
+        """On-demand bounded profiler capture (``/profile?seconds=N``):
+        fires the process trigger (created into a temp directory when
+        none is configured) and reports the artifact path, or 429 when
+        the rate limit (cooldown / capture in flight) suppressed it."""
+        trigger = _timeplane.get_trigger(create_default=True)
+        window = seconds if seconds is not None else trigger.seconds
+        path = trigger.fire("manual", seconds=window)
+        if path is None:
+            return 429, {
+                "fired": False,
+                "reason": "suppressed: capture in flight or inside the "
+                f"{trigger.cooldown_s}s cooldown",
+            }
+        return 200, {"fired": True, "path": path, "seconds": window}
 
 
 class _OpsHandler(BaseHTTPRequestHandler):
@@ -900,7 +946,8 @@ class _OpsHandler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
+        qs = parse_qs(query)
         plane: OpsPlane = self.server.plane  # type: ignore[attr-defined]
         try:
             if path == "/metrics":
@@ -912,12 +959,34 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 body = json.dumps(payload).encode("utf-8")
                 ctype = "application/json"
             elif path == "/requests":
-                code, payload = plane._requests()
+                try:
+                    limit = int(qs.get("limit", ["256"])[0])
+                    if limit < 1:  # the bound is the endpoint's contract
+                        raise ValueError
+                except ValueError:
+                    code, payload = 400, {"error": "limit must be an int >= 1"}
+                else:
+                    code, payload = plane._requests(limit=limit)
+                body = json.dumps(payload).encode("utf-8")
+                ctype = "application/json"
+            elif path == "/profile":
+                try:
+                    seconds = (
+                        float(qs["seconds"][0]) if "seconds" in qs else None
+                    )
+                    if seconds is not None and not 0 < seconds <= 600:
+                        raise ValueError
+                except ValueError:
+                    code, payload = 400, {
+                        "error": "seconds must be a float in (0, 600]"
+                    }
+                else:
+                    code, payload = plane._profile(seconds)
                 body = json.dumps(payload).encode("utf-8")
                 ctype = "application/json"
             else:
                 code, ctype = 404, "text/plain"
-                body = b"not found: /metrics /healthz /requests\n"
+                body = b"not found: /metrics /healthz /requests /profile\n"
         except Exception as e:  # noqa: BLE001 — a scrape must never crash
             code, ctype = 500, "text/plain"
             body = f"ops endpoint error: {e!r}\n".encode("utf-8")

@@ -63,6 +63,7 @@ from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from . import _core
+from . import timeplane as _timeplane
 
 __all__ = [
     "JitProgram",
@@ -317,6 +318,10 @@ def _note_tracked_compile(prog: str, owner: Any) -> None:
         n_recompiles=_STORM_N, window_s=_STORM_WINDOW_S,
         ledger=ledger.components(),
     )
+    # Trigger-fired profiler capture (rate-limited; no-op with no
+    # trigger installed): a storm's dump comes with a device profile of
+    # the recompiling window — the compile stalls are IN it.
+    _timeplane.fire_profile("recompile_storm", engine=eid or None, program=prog)
     if owner is not None:
         try:
             # The stall-watchdog convention: OVERLOADED routes a fleet
