@@ -1093,6 +1093,200 @@ def bench_fleet_failover():
     return out
 
 
+def bench_autoscale():
+    """Elastic fleet probe: what the observe→act loop buys in a flash
+    crowd.
+
+    Two arms over identical traffic (same seed, prompts, and token
+    budgets): a FIXED single-engine fleet, then the same fleet with the
+    signal-driven :class:`~torchdistx_tpu.fleet.Autoscaler` attached.  A
+    10× flash crowd with a microsecond-deadline subset lights the SLO
+    burn; the probe reports the autonomous time-to-recover (burn edge →
+    recovery edge, from the autoscaler's own burn-event log), the peak
+    replica count the loop reached, ramp TTFT p95 for both arms and
+    their ratio, and the dropped count, which must be 0 — deadline
+    misses are typed, anything else the elastic fleet must absorb.
+    Scale-in back to one replica is part of the measurement: the probe
+    fails the arm if the fleet does not land back at min.
+    """
+    import jax
+    import numpy as np
+
+    from torchdistx_tpu.fleet import AutoscaleConfig, Autoscaler, FleetRouter
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import (
+        DeadlineExceeded,
+        Engine,
+        RequestCancelled,
+        RequestError,
+    )
+    from torchdistx_tpu.telemetry import ops as tdx_ops
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_engine():
+        return Engine(
+            params, model=llama, cfg=cfg, num_slots=4, block_size=8,
+            num_blocks=33, max_model_len=64, decode_chunk=4,
+            drain_deadline_s=120.0, handle_preemption=False,
+        )
+
+    # Warm the compiled programs (shared jit cache across both arms).
+    warm = make_engine()
+    warm.submit(
+        np.arange(8, dtype=np.int32) % cfg.vocab_size,
+        max_new_tokens=4, key=0,
+    )
+    warm.drain()
+    warm.close()
+
+    n_crowd = 30
+
+    def arm(autoscale):
+        rng = np.random.default_rng(7)
+        router = FleetRouter(
+            [make_engine()], version="v1", max_hops=4,
+            ops_port=0, ops_config=tdx_ops.OpsConfig(
+                watchdog=False,
+                slo=tdx_ops.SLOConfig(
+                    slo=0.9, fast_window_s=2.0, slow_window_s=8.0,
+                    burn_threshold=2.0, min_samples=4,
+                ),
+            ),
+        )
+        scaler = None
+        if autoscale:
+            scaler = Autoscaler(
+                router, make_engine, version="v1",
+                config=AutoscaleConfig(
+                    min_replicas=1, max_replicas=3, fast_ticks=2,
+                    slope_window=4, slope_high=3.0, slow_ticks=6,
+                    scale_out_cooldown=4, scale_in_cooldown=6,
+                    queue_low_per_replica=1.0,
+                ),
+            )
+
+        handles, doomed, t_submit = [], set(), {}
+        for i in range(n_crowd):
+            plen = int(rng.integers(3, 14))
+            prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(
+                np.int32
+            )
+            d = None
+            if rng.random() < 0.3:
+                d = 1e-6  # typed misses that light the burn
+            h = router.submit(
+                prompt, max_new_tokens=int(rng.choice((4, 8, 12))),
+                key=i, deadline_s=d,
+            )
+            handles.append(h)
+            if d is not None:
+                doomed.add(id(h))
+            t_submit[id(h)] = time.perf_counter()
+
+        ttfts, dropped, typed, done = [], 0, 0, 0
+        peak = 1
+        gens = [(h, h.tokens(), True) for h in handles]
+        pulls = 0
+        while gens:
+            nxt = []
+            for h, g, first in gens:
+                try:
+                    next(g)
+                    if first and id(h) not in doomed:
+                        ttfts.append(
+                            time.perf_counter() - t_submit[id(h)]
+                        )
+                    nxt.append((h, g, False))
+                except StopIteration:
+                    done += 1
+                except RequestError as e:
+                    if isinstance(e, (DeadlineExceeded, RequestCancelled)):
+                        typed += 1
+                    else:
+                        dropped += 1
+                pulls += 1
+                if scaler is not None and pulls % 8 == 0:
+                    scaler.tick()
+                    peak = max(peak, len(router.replicas()))
+            gens = nxt
+
+        out = {
+            "completed": done,
+            "deadline_typed": typed,
+            "dropped": dropped,  # the acceptance number (must be 0)
+            "ramp_ttft_p95_s": round(
+                float(np.percentile(ttfts, 95)), 4
+            ) if ttfts else None,
+        }
+        if scaler is not None:
+            # Recovery: trickle good traffic until the burn clears.
+            t0 = time.perf_counter()
+            k = 10_000
+            while scaler.recoveries < 1:
+                if time.perf_counter() - t0 > 60.0:
+                    out["recover_timeout"] = True
+                    break
+                trio = [
+                    router.submit(
+                        rng.integers(0, cfg.vocab_size, size=6).astype(
+                            np.int32
+                        ),
+                        max_new_tokens=4, key=k + j,
+                    )
+                    for j in range(3)
+                ]
+                k += 3
+                for h in trio:
+                    for _ in h.tokens():
+                        pass
+                scaler.tick()
+                time.sleep(0.2)
+            edges = {}
+            for t, tenant, burning in scaler.burn_events:
+                edges.setdefault(burning, t)
+            if True in edges and False in edges:
+                out["time_to_recover_s"] = round(
+                    edges[False] - edges[True], 3
+                )
+            # Quiet down: the loop must land back at min replicas.
+            t0 = time.perf_counter()
+            while (
+                len(router.replicas()) > scaler.config.min_replicas
+                and time.perf_counter() - t0 < 120.0
+            ):
+                scaler.tick()
+                router.step()
+                time.sleep(0.02)
+            out["landed_at_min"] = (
+                len(router.replicas()) == scaler.config.min_replicas
+            )
+            out["peak_replicas"] = peak
+            out["scale_outs"] = scaler.scale_outs
+            out["scale_ins"] = scaler.scale_ins
+            scaler.close()
+        router.close()
+        return out
+
+    fixed = arm(autoscale=False)
+    auto = arm(autoscale=True)
+    out = {
+        "n_requests": n_crowd,
+        "fixed": fixed,
+        "autoscaled": auto,
+        "dropped": fixed["dropped"] + auto["dropped"],  # must be 0
+        "time_to_recover_s": auto.get("time_to_recover_s"),
+        "peak_replicas": auto.get("peak_replicas"),
+        "ramp_ttft_p95_s": auto.get("ramp_ttft_p95_s"),
+    }
+    if fixed.get("ramp_ttft_p95_s") and auto.get("ramp_ttft_p95_s"):
+        out["ttft_p95_vs_fixed"] = round(
+            auto["ramp_ttft_p95_s"] / fixed["ramp_ttft_p95_s"], 3
+        )
+    return out
+
+
 def bench_flash_attention(s=16384, b=1, h=8, d=128):
     """Long-context flash attention fwd+bwd at S=16k on one chip.
 
@@ -1215,6 +1409,10 @@ def main():
         fleet = bench_fleet_failover()
     except Exception as e:  # noqa: BLE001
         fleet = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        autoscale = bench_autoscale()
+    except Exception as e:  # noqa: BLE001
+        autoscale = {"error": f"{type(e).__name__}: {e}"}
     # Second flash probe, minutes after the first (same compiled program,
     # deterministic work): tunnel windows last minutes, so two temporally
     # separated samples of the same measurement keep one bad window from
@@ -1260,6 +1458,7 @@ def main():
                     "generate_llama_350m_decode": gen,
                     "serving_llama_350m_continuous": serving,
                     "fleet_failover": fleet,
+                    "fleet_autoscale": autoscale,
                     "cold_uncached_s": cold,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),

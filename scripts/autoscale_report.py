@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Read back the autoscaler's decision timeline from a telemetry trace.
+
+The fleet autoscaler (:mod:`torchdistx_tpu.fleet.autoscale`) emits one
+``fleet.autoscale`` event per control tick carrying the decision
+(``hold`` or one of the action reasons), the live replica count, the
+signals the decision was made on (occupancy, queue depth, queue slope,
+burning), and the tick number.  This tool reconstructs that timeline
+from a JSONL trace (chaos soak, bench, or production) and answers "what
+did the control loop see, and what did it do about it":
+
+* a **decision log** — every non-hold tick as a row: tick number,
+  reason, replica count before/after, and the signal snapshot that
+  justified it;
+* **action counts** per reason (``burn``, ``occupancy``, ``ttft``,
+  ``queue_slope``, ``below_min``, ``replace_diverging``, ``quiet``)
+  cross-checked against the ``fleet.scale_outs`` / ``fleet.scale_ins``
+  counters in the same trace — a mismatch means ticks ran with the
+  trace sink detached and the timeline is partial;
+* **replica-count envelope** (min/max/final) and the burn story:
+  ticks spent with an active SLO burn and whether the trace ends calm.
+
+Usage::
+
+    python scripts/autoscale_report.py /tmp/autoscale.jsonl
+    python scripts/autoscale_report.py trace.jsonl --json out.json
+    python scripts/autoscale_report.py trace.jsonl --require-actions
+        # CI gate: exit 1 unless the trace contains at least one
+        # scale-out AND one scale-in decision (the elastic round trip)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+__all__ = ["load_events", "summarize"]
+
+
+def load_events(path: str):
+    """``fleet.autoscale`` event records (tick order) + final counter
+    snapshot from a JSONL trace."""
+    ticks: List[Dict[str, Any]] = []
+    counters: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "event" and rec.get("name") == "fleet.autoscale":
+                attrs = rec.get("attrs") or {}
+                if "tick" in attrs:
+                    ticks.append(dict(attrs, ts=rec.get("ts")))
+            elif rec.get("type") == "counters":
+                counters.update(rec.get("values") or {})
+    ticks.sort(key=lambda a: a["tick"])
+    return ticks, counters
+
+
+_OUT_REASONS = ("burn", "occupancy", "ttft", "queue_slope", "below_min",
+                "replace_diverging")
+_IN_REASONS = ("quiet",)
+
+
+def summarize(ticks, counters) -> Dict[str, Any]:
+    actions = [t for t in ticks if t.get("decision") not in (None, "hold")]
+    by_reason: Dict[str, int] = {}
+    for t in actions:
+        by_reason[t["decision"]] = by_reason.get(t["decision"], 0) + 1
+    replicas = [t.get("replicas", 0) for t in ticks]
+    outs = sum(n for r, n in by_reason.items() if r in _OUT_REASONS)
+    ins = sum(n for r, n in by_reason.items() if r in _IN_REASONS)
+    burn_ticks = sum(1 for t in ticks if t.get("burning"))
+    return {
+        "ticks": len(ticks),
+        "actions": len(actions),
+        "by_reason": by_reason,
+        "scale_out_decisions": outs,
+        "scale_in_decisions": ins,
+        "replicas_min": min(replicas) if replicas else 0,
+        "replicas_max": max(replicas) if replicas else 0,
+        "replicas_final": replicas[-1] if replicas else 0,
+        "burn_ticks": burn_ticks,
+        "ends_burning": bool(ticks and ticks[-1].get("burning")),
+        "counter_scale_outs": counters.get("fleet.scale_outs", 0),
+        "counter_scale_ins": counters.get("fleet.scale_ins", 0),
+        "decision_log": [
+            {
+                "tick": t["tick"],
+                "reason": t["decision"],
+                "replicas": t.get("replicas"),
+                "occupancy": t.get("occupancy"),
+                "queue": t.get("queue"),
+                "queue_slope": t.get("queue_slope"),
+                "burning": t.get("burning"),
+            }
+            for t in actions
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Autoscaler decision-timeline readback")
+    ap.add_argument("trace", help="telemetry JSONL trace path")
+    ap.add_argument("--json", metavar="OUT", help="write summary as JSON")
+    ap.add_argument(
+        "--require-actions", action="store_true",
+        help="exit 1 unless the trace holds >=1 scale-out and >=1 "
+             "scale-in decision",
+    )
+    args = ap.parse_args(argv)
+
+    ticks, counters = load_events(args.trace)
+    s = summarize(ticks, counters)
+
+    print(f"autoscale_report: {s['ticks']} ticks, {s['actions']} actions")
+    print(
+        f"  replicas {s['replicas_min']}..{s['replicas_max']} "
+        f"(final {s['replicas_final']}), burn on {s['burn_ticks']} ticks"
+        + (" — ENDS BURNING" if s["ends_burning"] else "")
+    )
+    for row in s["decision_log"]:
+        print(
+            f"  tick {row['tick']:>5}  {row['reason']:<18} "
+            f"replicas={row['replicas']}  occ={row['occupancy']}  "
+            f"queue={row['queue']}  slope={row['queue_slope']}"
+            f"{'  [burning]' if row['burning'] else ''}"
+        )
+    if not s["decision_log"]:
+        print("  (no non-hold decisions in trace)")
+    print(
+        f"  counters: fleet.scale_outs={s['counter_scale_outs']} "
+        f"fleet.scale_ins={s['counter_scale_ins']}"
+    )
+
+    rc = 0
+    # Counter cross-check: decision events and counters travel separate
+    # paths; fewer events than counted actions means a partial timeline.
+    if (s["scale_out_decisions"] < s["counter_scale_outs"]
+            or s["scale_in_decisions"] < s["counter_scale_ins"]):
+        print(
+            "autoscale_report: WARNING — trace has fewer decision events "
+            "than counted actions (timeline partial?)", file=sys.stderr,
+        )
+    if args.require_actions:
+        if s["scale_out_decisions"] < 1:
+            print("autoscale_report: FAIL — no scale-out decision in trace",
+                  file=sys.stderr)
+            rc = 1
+        if s["scale_in_decisions"] < 1:
+            print("autoscale_report: FAIL — no scale-in decision in trace",
+                  file=sys.stderr)
+            rc = 1
+        if s["ends_burning"]:
+            print("autoscale_report: FAIL — trace ends with an active burn",
+                  file=sys.stderr)
+            rc = 1
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(s, f, indent=2, sort_keys=True)
+        print(f"autoscale_report: wrote {args.json}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

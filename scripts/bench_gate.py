@@ -70,6 +70,17 @@ METRICS: List[Tuple[str, Tuple[str, ...], bool, float]] = [
     # vacuously (no_baseline) until a round records it.
     ("serving_audit_sustained_ratio",
      _SERVING + ("audit", "sustained_ratio"), True, 0.25),
+    # Autoscale loop (ISSUE 16): the elastic-fleet probe's burn-edge →
+    # recovery-edge wall time, the flash-crowd ramp TTFT p95 under the
+    # autoscaler, and the dropped count — zero tolerance: once history
+    # records dropped == 0, any drop at all regresses.  All gate
+    # vacuously (no_baseline) until a round records them.
+    ("autoscale_recover_s",
+     ("details", "fleet_autoscale", "time_to_recover_s"), False, 0.60),
+    ("autoscale_ramp_ttft_p95_s",
+     ("details", "fleet_autoscale", "ramp_ttft_p95_s"), False, 0.50),
+    ("autoscale_dropped",
+     ("details", "fleet_autoscale", "dropped"), False, 0.0),
 ]
 
 

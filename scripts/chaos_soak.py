@@ -86,11 +86,30 @@ the final trace assertion gates ``audit.checked >= 1`` AND
 failovers, and swaps all replay token-identically must ALSO re-execute
 divergence-free at 100% sampling.
 
-CI (.github/workflows/ci.yaml, chaos-soak + fleet-chaos jobs) runs both
-modes with ``TDX_TELEMETRY`` set.  Locally:
+**Autoscale mode** (``python scripts/chaos_soak.py autoscale``, ISSUE
+16 acceptance gate): the observe→act loop under chaos.  A
+:class:`~torchdistx_tpu.fleet.Autoscaler` owns a QoS fleet (min 1, max
+3) on an ops plane with tight SLO windows, and three scenarios run
+through it — a **flash crowd** (10× arrival step with deadline-doomed
+requests that burn the SLO), a **diurnal ramp** (arrivals up then
+down), and a **one-tenant runaway** under QoS weights — with a replica
+**killed mid-crowd** and a **hot swap to v2** triggered concurrently,
+at 100% audit sampling.  Gates: zero requests lost to infrastructure
+(deadline/cancel typed failures only), ``audit.divergences == 0``, the
+SLO burn fires AND recovers with no human action
+(``scaler.recoveries >= 1``), scale-in lands back at ``min_replicas``
+with a bounded decision count (no flap), and the exported trace shows
+``fleet.scale_outs >= 1`` + ``fleet.scale_ins >= 1`` plus the
+``fleet.autoscale`` decision events ``scripts/autoscale_report.py``
+reads back.  ``trace_report --strict`` and ``timeline_export
+--validate`` must stay green over the same trace (CI wires all three).
+
+CI (.github/workflows/ci.yaml, chaos-soak + fleet-chaos +
+autoscale-chaos jobs) runs all modes with ``TDX_TELEMETRY`` set.
+Locally:
 
     TDX_TELEMETRY=/tmp/chaos.jsonl JAX_PLATFORMS=cpu \\
-    python scripts/chaos_soak.py [fleet]
+    python scripts/chaos_soak.py [fleet|autoscale]
 """
 
 import json
@@ -1197,7 +1216,354 @@ def fleet_main() -> int:
     return 0
 
 
+def autoscale_main() -> int:
+    """Autoscale chaos (ISSUE 16): flash crowd, diurnal ramp, runaway
+    tenant — the autoscaler must recover the SLO burn autonomously,
+    with a kill + hot swap + 100% audit riding along, zero dropped
+    requests, and scale-in back to min replicas with no flap."""
+    trace = os.environ.get("TDX_TELEMETRY", "")
+    if not trace:
+        print("chaos_soak: set TDX_TELEMETRY", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.fleet import (
+        Autoscaler,
+        AutoscaleConfig,
+        FleetRouter,
+        hot_swap,
+    )
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import (
+        DeadlineExceeded,
+        Engine,
+        Health,
+        RequestCancelled,
+        RequestError,
+    )
+    from torchdistx_tpu.telemetry import ops as tdx_ops
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(SEED)
+
+    def make_engine():
+        # QoS engines (the runaway scenario needs fair queueing), sized
+        # for queue pressure so the crowd actually queues.
+        return Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+            block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+            drain_deadline_s=120.0, handle_preemption=False,
+            scheduler="qos", tenant_weights={"gold": 4.0, "runaway": 0.5},
+        )
+
+    # Tight SLO windows (event-time seconds): the flash crowd's misses
+    # must burn, and sustained good traffic must clear the burn, within
+    # a CPU soak's wall clock.  Watchdog off: handles drive the engines
+    # pull-by-pull, long idle gaps are normal here.
+    router = FleetRouter(
+        [make_engine()], version="v1", max_hops=4,
+        ops_port=0, ops_config=tdx_ops.OpsConfig(
+            watchdog=False,
+            slo=tdx_ops.SLOConfig(
+                slo=0.9, fast_window_s=2.0, slow_window_s=8.0,
+                burn_threshold=2.0, min_samples=4,
+            ),
+        ),
+    )
+    ops_url = router.ops_plane.server.url
+    scaler = Autoscaler(
+        router, make_engine, version="v1",
+        config=AutoscaleConfig(
+            min_replicas=1, max_replicas=3, fast_ticks=2,
+            occupancy_high=0.85, occupancy_low=0.3,
+            queue_low_per_replica=1.0, slope_window=4, slope_high=3.0,
+            slow_ticks=6, scale_out_cooldown=4, scale_in_cooldown=6,
+        ),
+    )
+
+    n_ok = n_typed = 0
+    chaos = {"killed": False, "swapped": False}
+
+    def submit(n, *, key_base, tenant="default", priority=0,
+               deadline=None, doomed_frac=0.0):
+        out = []
+        for i in range(n):
+            plen = int(rng.integers(3, 14))
+            prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(
+                np.int32
+            )
+            d = deadline
+            if doomed_frac and rng.random() < doomed_frac:
+                # Deadline-doomed: the deterministic stand-in for "the
+                # crowd exceeded capacity" — misses the SLO monitor
+                # counts, typed failures the drop gate permits.
+                d = 1e-6
+            out.append(router.submit(
+                prompt, max_new_tokens=int(rng.choice((4, 8, 12))),
+                key=key_base + i, deadline_s=d,
+                tenant=tenant, priority=priority,
+            ))
+        return out
+
+    def classify(label, handles):
+        nonlocal n_ok, n_typed
+        for h in handles:
+            if not h.done:
+                return f"[{label}] a request neither finished nor failed"
+            if h.error is None:
+                n_ok += 1
+            elif not isinstance(h.error, RequestError):
+                return (
+                    f"[{label}] request failed UNTYPED: "
+                    f"{type(h.error).__name__}: {h.error}"
+                )
+            elif isinstance(h.error, (DeadlineExceeded, RequestCancelled)):
+                n_typed += 1
+            else:
+                # Lost to infrastructure — the autoscaler/router's job
+                # was to absorb the chaos, not to shed it untyped.
+                return f"[{label}] request lost to infrastructure: {h.error!r}"
+        return None
+
+    def drive(label, handles, *, pulls_per_tick=8, mid=None):
+        """Round-robin pull every handle to completion, ticking the
+        control loop as the traffic flows; ``mid`` maps pull-fraction →
+        callback (the kill / swap chaos hooks)."""
+        gens = [(h, h.tokens()) for h in handles]
+        n_pulls = 0
+        fired = set()
+        # Rough pull budget for the mid-point hooks: max_new ≤ 12.
+        est_total = max(1, 12 * len(handles))
+        for _ in range(MAX_STEPS):
+            if not gens:
+                return None
+            nxt = []
+            for h, g in gens:
+                try:
+                    next(g)
+                    nxt.append((h, g))
+                except (StopIteration, RequestError):
+                    pass
+                n_pulls += 1
+                if n_pulls % pulls_per_tick == 0:
+                    scaler.tick()
+                for frac, hook in (mid or {}).items():
+                    if frac not in fired and n_pulls >= frac * est_total:
+                        fired.add(frac)
+                        hook()
+            gens = nxt
+        return f"[{label}] drive loop exceeded {MAX_STEPS} passes (hang)"
+
+    def kill_one():
+        live = [
+            rep for rep in router.replicas()
+            if rep.engine.health() not in (Health.STOPPED, Health.DRAINING)
+        ]
+        if len(live) > 1:
+            victim = live[-1].engine  # the newest spawn
+            for leaf in jax.tree.leaves(victim._cache):
+                leaf.delete()
+            victim.close()
+            chaos["killed"] = True
+
+    def swap_v2():
+        hot_swap(router, make_engine, version="v2")
+        scaler.version = "v2"  # later spawns join the new version
+        chaos["swapped"] = True
+
+    # ---------------- Scenario 1: flash crowd ----------------
+    baseline = max(2, min(6, N_REQUESTS // 50))
+    crowd = 10 * baseline  # the 10x arrival step
+    warm = submit(baseline, key_base=0)
+    err = drive("warmup", warm) or classify("warmup", warm)
+    if err:
+        return fail(err)
+    wave = submit(crowd, key_base=1_000, doomed_frac=0.3)
+    # Kill at ~30% of the ESTIMATED pulls: the estimate assumes the max
+    # token budget while the mean is lower, so a late fraction can land
+    # past the end of the drive and never fire.
+    err = drive(
+        "flash-crowd", wave,
+        mid={0.3: kill_one},  # device failure amid the crowd
+    ) or classify("flash-crowd", wave)
+    if err:
+        return fail(err)
+    if not chaos["killed"]:
+        return fail(
+            "kill hook never fired mid-crowd (fleet stayed at 1 replica "
+            f"too long; decisions: {list(scaler.decisions)})"
+        )
+    samples = scrape(ops_url)  # mid-soak: control plane in /metrics
+    if pick(samples, "fleet_replicas_target") is None:
+        return fail("fleet_replicas_target missing from /metrics")
+    if not any(
+        pick(samples, "serve_queue_depth", engine=rep.engine.engine_id)
+        is not None
+        for rep in router.replicas()
+    ):
+        return fail("per-engine serve_queue_depth family missing from scrape")
+    if scaler.scale_outs < 1:
+        return fail(
+            f"flash crowd did not scale out (decisions: "
+            f"{list(scaler.decisions)})"
+        )
+    # Recovery trickle: sustained good traffic must CLEAR the burn with
+    # no human action (bounded wait — event time is wall time here).
+    t0 = time.monotonic()
+    k = 20_000
+    while scaler.recoveries < 1:
+        if time.monotonic() - t0 > 120.0:
+            return fail("SLO burn did not recover within 120 s of the crowd")
+        trickle = submit(3, key_base=k)
+        k += 3
+        err = drive("recovery", trickle) or classify("recovery", trickle)
+        if err:
+            return fail(err)
+        time.sleep(0.25)
+    print(
+        f"chaos_soak: autoscale flash-crowd OK — scale_outs="
+        f"{scaler.scale_outs}, killed={chaos['killed']}, burn recovered "
+        f"in {time.monotonic() - t0:.1f}s"
+    )
+
+    # ---------------- Scenario 2: diurnal ramp (+ hot swap) ----------------
+    k = 40_000
+    for i, load in enumerate((2, 4, 6, 8, 6, 4, 2)):
+        ramp = submit(load, key_base=k)
+        k += load
+        hooks = {0.5: swap_v2} if i == 4 else None  # upgrade on the way down
+        err = drive("diurnal", ramp, mid=hooks) or classify("diurnal", ramp)
+        if err:
+            return fail(err)
+    if not chaos["swapped"]:
+        return fail("diurnal ramp never hot-swapped")
+    versions = {rep.version for rep in router.replicas()}
+    if versions != {"v2"}:
+        return fail(f"fleet did not converge on v2: {versions}")
+    print("chaos_soak: autoscale diurnal OK — hot swap to v2 under load")
+
+    # ---------------- Scenario 3: one-tenant runaway under QoS ----------------
+    runaway = submit(16, key_base=60_000, tenant="runaway", priority=0)
+    gold = submit(6, key_base=61_000, tenant="gold", priority=1,
+                  deadline=30.0)
+    err = (drive("runaway", runaway + gold)
+           or classify("runaway", runaway + gold))
+    if err:
+        return fail(err)
+    for h in gold:
+        if h.error is not None:
+            return fail("QoS failed to protect the gold tenant from "
+                        f"the runaway: {h.error!r}")
+    print("chaos_soak: autoscale runaway OK — gold tenant protected")
+
+    # ---------------- Quiet-down: scale-in back to min ----------------
+    t0 = time.monotonic()
+    while True:
+        scaler.tick()
+        router.step()
+        live = [
+            rep.engine for rep in router.replicas()
+            if rep.engine.health() is not Health.STOPPED
+        ]
+        if (
+            len(router.replicas()) == scaler.config.min_replicas
+            and not any(
+                len(e.scheduler) or e._n_running() or e.audit_backlog()
+                for e in live
+            )
+        ):
+            break
+        if time.monotonic() - t0 > 180.0:
+            return fail(
+                f"fleet did not land at min replicas "
+                f"({len(router.replicas())} live, decisions: "
+                f"{list(scaler.decisions)})"
+            )
+        time.sleep(0.02)
+    if scaler.scale_ins < 1:
+        return fail("soak ended without a scale-in")
+    if scaler.monitor is not None and any(scaler.monitor.burning().values()):
+        return fail(f"still burning at quiesce: {scaler.monitor.burning()}")
+    # No flap: every decision was load-driven; a bounded count is the
+    # hysteresis working (the unit tests pin the band itself).
+    n_decisions = scaler.scale_outs + scaler.scale_ins + scaler.replaces
+    if n_decisions > 12:
+        return fail(
+            f"{n_decisions} scaling decisions — flapping "
+            f"({list(scaler.decisions)})"
+        )
+    # Leak accounting on the survivors (stopped replicas released with
+    # their engines).
+    for rep in router.replicas():
+        eng = rep.engine
+        indexed = len(eng.prefix) if eng.prefix is not None else 0
+        if eng.allocator.num_in_use != indexed:
+            return fail(
+                f"replica {rep.rid} leaked {eng.allocator.num_in_use} "
+                f"pages ({indexed} indexed)"
+            )
+    scaler.close()
+    router.close()
+    try:
+        scrape(ops_url)
+        return fail("ops plane still up after router.close()")
+    except OSError:
+        pass
+    print(
+        f"chaos_soak: autoscale quiesce OK — min replicas, "
+        f"{n_decisions} decisions (outs={scaler.scale_outs}, "
+        f"ins={scaler.scale_ins}, replaces={scaler.replaces}), "
+        f"{n_ok} completed + {n_typed} typed deadline/cancel"
+    )
+
+    # ---------------- Trace assertions ----------------
+    telemetry.emit_counters()
+    spans, counters, dumps, events = parse_trace(trace)
+    if counters.get("fleet.scale_outs", 0) < 1:
+        return fail("trace shows no fleet.scale_outs")
+    if counters.get("fleet.scale_ins", 0) < 1:
+        return fail("trace shows no fleet.scale_ins")
+    if counters.get("serve.slo_burns", 0) < 1:
+        return fail("trace shows no serve.slo_burns from the flash crowd")
+    decisions = [
+        rec for rec in events.get("fleet.autoscale", ())
+        if (rec.get("attrs") or {}).get("decision") not in (None, "hold")
+    ]
+    if not decisions:
+        return fail("trace has no fleet.autoscale decision events")
+    if os.environ.get("TDX_FLIGHT_RECORDER") and "slo_burn" not in dumps:
+        return fail(f"trace shows no reason=slo_burn dump (dumps: {dumps})")
+    if AUDITING:
+        if counters.get("audit.checked", 0) < 1:
+            return fail("TDX_AUDIT_SAMPLE set but no audit.checked in trace")
+        if counters.get("audit.divergences", 0) != 0:
+            return fail(
+                f"audit.divergences = {counters.get('audit.divergences')} "
+                "!= 0 in the autoscale soak"
+            )
+    missing = {"fleet.swap", "serve.drain", "serve.prefill"} - spans
+    if missing:
+        return fail(f"trace missing spans {missing}")
+    print(
+        "chaos_soak: autoscale trace OK — "
+        f"scale_outs={counters.get('fleet.scale_outs')}, "
+        f"scale_ins={counters.get('fleet.scale_ins')}, "
+        f"slo_burns={counters.get('serve.slo_burns')}, "
+        f"decisions={len(decisions)}, "
+        f"audit.checked={counters.get('audit.checked', 0)}"
+    )
+    return 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         sys.exit(fleet_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "autoscale":
+        sys.exit(autoscale_main())
     sys.exit(main())

@@ -1,0 +1,525 @@
+"""ISSUE 16: signal-driven elastic autoscaler — the observe→act loop.
+
+The :class:`~torchdistx_tpu.fleet.Autoscaler` must scale out on
+sustained occupancy / SLO burn / queue-slope prediction, scale in only
+after a sustained quiet window, never flap inside the hysteresis band,
+respect cooldowns and min/max bounds, replace latched-diverging replicas
+instead of counting them as capacity, and reap STOPPED replicas from its
+own control tick (no manual ``poll()``).  Rides along: the per-engine
+``serve.queue_depth{engine=}`` gauge family (satellite 1), the router's
+reap-listener supervision hook (satellite 2), and the SLOMonitor
+burn-listener composition edge cases (satellite 3).
+"""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.fleet import Autoscaler, AutoscaleConfig, FleetRouter
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.serving import Engine, Health
+from torchdistx_tpu.telemetry import ops
+
+ENGINE_KW = dict(
+    num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
+    handle_preemption=False, prefix_cache=False,
+)
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def make_engine(family, **over):
+    model, cfg, params = family
+    kw = {**ENGINE_KW, **over}
+    return Engine(params, model=model, cfg=cfg, **kw)
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Fake engines: the policy is pure control logic over the engine
+# health/occupancy/queue surface, so the policy units run on duck-typed
+# fakes (the real-engine integration rides below).
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.n = 0
+
+    def __len__(self):
+        return self.n
+
+
+class FakeEngine:
+    _seq = itertools.count()
+
+    def __init__(self, occ=0.0, queue=0, slots=4):
+        self.engine_id = f"fake{next(FakeEngine._seq)}"
+        self.num_slots = slots
+        self.scheduler = _FakeScheduler()
+        self.scheduler.n = queue
+        self.occ = occ
+        self.est = 0.01
+        self._health = Health.READY
+        self._diverging = False
+        self.drain_steps = 1  # steps a drain takes to land at STOPPED
+        self.closed = False
+
+    def health(self):
+        return self._health
+
+    def est_ttft_s(self):
+        return self.est
+
+    def _n_running(self):
+        return int(round(self.occ * self.num_slots))
+
+    def begin_drain(self):
+        if self._health is not Health.STOPPED:
+            self._health = Health.DRAINING
+
+    def step(self):
+        if self._health is Health.DRAINING:
+            self.drain_steps -= 1
+            if self.drain_steps <= 0:
+                self._health = Health.STOPPED
+
+    def close(self):
+        self._health = Health.STOPPED
+        self.closed = True
+
+
+def fake_fleet(n=1, cfg=None, monitor=None, **fake_kw):
+    router = FleetRouter([])
+    made = []
+
+    def factory():
+        eng = FakeEngine(**fake_kw)
+        made.append(eng)
+        return eng
+
+    for _ in range(n):
+        router.add_replica(factory())
+    scaler = Autoscaler(
+        router, factory, config=cfg, monitor=monitor
+    )
+    return router, scaler, made
+
+
+def live(router):
+    return [r for r in router.replicas()
+            if r.engine.health() is not Health.DRAINING]
+
+
+def set_occ(router, v):
+    for rep in router.replicas():
+        rep.engine.occ = v
+
+
+# ---------------------------------------------------------------------------
+# Policy: scale-out
+
+
+def test_scale_out_on_sustained_occupancy():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3, fast_ticks=2)
+    router, scaler, made = fake_fleet(1, cfg=cfg)
+    set_occ(router, 0.95)
+    assert scaler.tick() == "hold"  # one high tick is not sustained
+    assert scaler.tick() == "occupancy"
+    assert scaler.scale_outs == 1
+    assert len(router.replicas()) == 2
+    assert telemetry.gauges()["fleet.replicas_target"] == 2
+    scaler.close()
+
+
+def test_high_blip_does_not_scale():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3, fast_ticks=2)
+    router, scaler, _ = fake_fleet(1, cfg=cfg)
+    set_occ(router, 0.95)
+    scaler.tick()
+    set_occ(router, 0.1)  # blip over before the sustain window filled
+    for _ in range(10):
+        scaler.tick()
+    assert scaler.scale_outs == 0
+    assert len(router.replicas()) == 1
+    scaler.close()
+
+
+def test_hysteresis_band_never_flaps():
+    """A signal oscillating INSIDE the band (above low water, below
+    high water) must produce zero decisions in either direction."""
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=4, occupancy_low=0.3,
+        occupancy_high=0.85, fast_ticks=1, slow_ticks=2,
+        scale_out_cooldown=1, scale_in_cooldown=1,
+    )
+    router, scaler, _ = fake_fleet(2, cfg=cfg)
+    for i in range(30):
+        set_occ(router, 0.4 if i % 2 else 0.7)
+        assert scaler.tick() == "hold"
+    assert scaler.scale_outs == 0 and scaler.scale_ins == 0
+    assert len(router.replicas()) == 2
+    assert len(scaler.decisions) == 0
+    scaler.close()
+
+
+def test_scale_out_cooldown_and_max_bound():
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, fast_ticks=1, scale_out_cooldown=3,
+    )
+    # Every replica (including fresh spawns) reports saturated.
+    router, scaler, _ = fake_fleet(1, cfg=cfg, occ=0.95)
+    reasons = [scaler.tick() for _ in range(12)]
+    outs = [i for i, r in enumerate(reasons) if r == "occupancy"]
+    assert len(outs) == 2  # 1 → 2 → 3, then capped at max_replicas
+    assert outs[1] - outs[0] >= cfg.scale_out_cooldown
+    assert len(router.replicas()) == 3
+    assert scaler.scale_outs == 2
+    scaler.close()
+
+
+def test_queue_slope_predictor_prescales():
+    """Queue growth pre-scales BEFORE occupancy crosses its threshold."""
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, fast_ticks=5,
+        slope_window=3, slope_high=2.0, occupancy_high=0.9,
+    )
+    router, scaler, made = fake_fleet(1, cfg=cfg, occ=0.5)
+    for i in range(6):
+        made[0].scheduler.n = 4 * i  # +4 requests per tick
+        if scaler.tick() == "queue_slope":
+            break
+    assert scaler.scale_outs == 1
+    assert ("queue_slope" in [d[1] for d in scaler.decisions])
+    assert len(router.replicas()) == 2
+    scaler.close()
+
+
+# ---------------------------------------------------------------------------
+# Policy: scale-in
+
+
+def test_scale_in_lands_at_min_without_flap():
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=4, slow_ticks=2,
+        scale_in_cooldown=2, scale_out_cooldown=1,
+    )
+    router, scaler, _ = fake_fleet(3, cfg=cfg)  # idle fleet of 3
+    for _ in range(30):
+        scaler.tick()
+    assert len(router.replicas()) == 1
+    assert scaler.scale_ins == 2
+    assert scaler.scale_outs == 0  # never bounced back up
+    assert telemetry.gauges()["fleet.replicas_target"] == 1
+    # Victims were DRAINED (graceful), not closed.
+    scaler.close()
+
+
+def test_scale_in_blocked_at_min():
+    cfg = AutoscaleConfig(
+        min_replicas=2, max_replicas=4, slow_ticks=1,
+        scale_in_cooldown=1, scale_out_cooldown=1,
+    )
+    router, scaler, _ = fake_fleet(2, cfg=cfg)
+    for _ in range(10):
+        scaler.tick()
+    assert len(router.replicas()) == 2
+    assert scaler.scale_ins == 0
+    scaler.close()
+
+
+def test_busy_fleet_does_not_scale_in():
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=4, slow_ticks=1,
+        scale_in_cooldown=1, occupancy_low=0.3,
+    )
+    router, scaler, _ = fake_fleet(2, cfg=cfg, occ=0.5)  # inside the band
+    for _ in range(10):
+        scaler.tick()
+    assert scaler.scale_ins == 0
+    scaler.close()
+
+
+# ---------------------------------------------------------------------------
+# Policy: supervision, deficit repair, divergence replacement
+
+
+def test_stopped_replica_reaped_and_respawned_below_min():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3)
+    router, scaler, made = fake_fleet(1, cfg=cfg)
+    reaped = []
+    router.add_reap_listener(lambda rid, eng: reaped.append(rid))
+    made[0].close()  # crash — user code never calls poll()
+    assert scaler.tick() == "below_min"
+    assert reaped == [0]
+    reps = router.replicas()
+    assert len(reps) == 1 and reps[0].engine is not made[0]
+    scaler.close()
+
+
+def test_diverging_replica_replaced_never_capacity():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3)
+    router, scaler, made = fake_fleet(2, cfg=cfg)
+    bad = made[0]
+    bad._diverging = True
+    assert scaler.tick() == "replace_diverging"
+    assert scaler.replaces == 1
+    assert bad.health() in (Health.DRAINING, Health.STOPPED)
+    by_eng = {rep.engine for rep in router.replicas()}
+    assert len([e for e in by_eng if e is not bad]) == 2  # replacement up
+    # The drained incident engine is reaped by subsequent ticks.
+    for _ in range(3):
+        scaler.tick()
+    assert bad not in {rep.engine for rep in router.replicas()}
+    assert len(router.replicas()) == 2
+    assert scaler.scale_outs == 0  # replacement is not load-driven growth
+    scaler.close()
+
+
+# ---------------------------------------------------------------------------
+# Burn-signal consumption (satellite 3: the SLOMonitor edge cases the
+# autoscaler depends on)
+
+
+def _req_event(name, rid, ts, **attrs):
+    return {"type": "event", "name": name, "rid": rid, "ts": ts,
+            "attrs": attrs}
+
+
+def _feed_terminal(mon, rid, ts, tenant="acme", ok=True):
+    mon._on_record(_req_event("req.submitted", rid, ts, tenant=tenant))
+    if ok:
+        mon._on_record(_req_event("req.finished", rid, ts + 0.01))
+    else:
+        mon._on_record(
+            _req_event("req.failed", rid, ts + 0.01,
+                       error="DeadlineExceeded", retryable=False)
+        )
+
+
+def _slo_cfg(**over):
+    kw = dict(slo=0.9, fast_window_s=10, slow_window_s=50,
+              burn_threshold=2.0, min_samples=5)
+    kw.update(over)
+    return ops.SLOConfig(**kw)
+
+
+def test_burn_fires_scale_out_then_refires_only_if_still_burning():
+    mon = ops.SLOMonitor(_slo_cfg())
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=4, scale_out_cooldown=2,
+    )
+    router, scaler, _ = fake_fleet(1, cfg=cfg, monitor=mon)
+    t0 = 1000.0
+    for i in range(8):
+        _feed_terminal(mon, i, t0 + i * 0.1, ok=False)
+    assert scaler.tick() == "burn"
+    assert scaler.scale_outs == 1
+    # Burn persists: after the cooldown the LIVE monitor state re-fires.
+    scaler.tick()
+    assert scaler.scale_outs == 1  # inside cooldown
+    scaler.tick()
+    assert scaler.scale_outs == 2  # cooldown over, still burning
+    scaler.close()
+    mon.close()
+
+
+def test_burn_clearing_mid_cooldown_does_not_double_fire():
+    mon = ops.SLOMonitor(_slo_cfg())
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=4, scale_out_cooldown=4,
+    )
+    router, scaler, _ = fake_fleet(1, cfg=cfg, monitor=mon)
+    t0 = 1000.0
+    for i in range(8):
+        _feed_terminal(mon, i, t0 + i * 0.1, ok=False)
+    assert scaler.tick() == "burn"
+    assert scaler.scale_outs == 1
+    # The burn CLEARS while the cooldown still runs (a genuine
+    # recovery transition: the bad window ages out).
+    for i in range(20):
+        _feed_terminal(mon, 100 + i, t0 + 60 + i * 0.1, ok=True)
+    assert mon.burning() == {"acme": False}
+    assert scaler.recoveries == 1
+    # Past the cooldown: the stale edge latch must NOT fire a second
+    # scale-out — decision time re-checks the live monitor.  (The now
+    # idle extra replica MAY scale back in: that's the recovery working,
+    # not a flap.)
+    reasons = [scaler.tick() for _ in range(10)]
+    assert all(r in ("hold", "quiet") for r in reasons)
+    assert scaler.scale_outs == 1
+    scaler.close()
+    mon.close()
+
+
+def test_idle_pruned_tenant_is_not_a_recovery():
+    mon = ops.SLOMonitor(_slo_cfg())
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=4)
+    router, scaler, _ = fake_fleet(1, cfg=cfg, monitor=mon)
+    t0 = 1000.0
+    for i in range(8):
+        _feed_terminal(mon, i, t0 + i * 0.1, ok=False)
+    scaler.tick()
+    assert scaler.scale_outs == 1
+    # The tenant goes silent and the monitor prunes it for idleness.
+    with mon._lock:
+        mon._prune_idle(t0 + 10_000.0)
+    # NOT a recovery: no burning=False edge reached the listener, the
+    # gauge left the registry rather than reading 0.
+    assert scaler.recoveries == 0
+    assert not any(
+        t == "acme" and not burning
+        for _, t, burning in scaler.burn_events
+    )
+    assert "serve.slo_burning{tenant=acme}" not in telemetry.gauges()
+    scaler.close()
+    mon.close()
+
+
+def test_burn_listeners_compose_with_primary_order_pinned():
+    calls = []
+    mon = ops.SLOMonitor(_slo_cfg(
+        on_burn=lambda tenant, info: calls.append("primary"),
+    ))
+    mon.add_burn_listener(
+        lambda tenant, burning, info: calls.append(("l1", burning)))
+    mon.add_burn_listener(
+        lambda tenant, burning, info: calls.append(("l2", burning)))
+    t0 = 1000.0
+    for i in range(8):
+        _feed_terminal(mon, i, t0 + i * 0.1, ok=False)
+    # BOTH ran — the listener API composes with (never replaces) the
+    # primary on_burn — and the primary ran FIRST.
+    assert calls == ["primary", ("l1", True), ("l2", True)]
+    # Recovery edges reach listeners (with info=None semantics) but not
+    # the primary (which is the burn-incident action).
+    for i in range(20):
+        _feed_terminal(mon, 100 + i, t0 + 60 + i * 0.1, ok=True)
+    assert calls == [
+        "primary", ("l1", True), ("l2", True), ("l1", False), ("l2", False),
+    ]
+    mon.close()
+
+
+def test_default_flight_dump_still_runs_under_listeners():
+    """With no custom on_burn, registering a listener must not silence
+    the default flight-dump action (the pre-listener behavior)."""
+    calls = []
+    mon = ops.SLOMonitor(_slo_cfg())
+    mon._default_on_burn = lambda tenant, info: calls.append("default")
+    mon.add_burn_listener(
+        lambda tenant, burning, info: calls.append("listener"))
+    t0 = 1000.0
+    for i in range(8):
+        _feed_terminal(mon, i, t0 + i * 0.1, ok=False)
+    assert calls == ["default", "listener"]
+    mon.close()
+
+
+# ---------------------------------------------------------------------------
+# Config validation
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        AutoscaleConfig(occupancy_low=0.9, occupancy_high=0.8).validate()
+    with pytest.raises(ValueError):
+        AutoscaleConfig(fast_ticks=0).validate()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: the per-engine serve.queue_depth{engine=} family (real
+# engines, both scheduler flavors)
+
+
+@pytest.mark.parametrize("sched", ["fifo", "qos"])
+def test_queue_depth_per_engine_family_two_engines(family, sched):
+    eng_a = make_engine(family, scheduler=sched)
+    eng_b = make_engine(family, scheduler=sched)
+    ha = [eng_a.submit(prompt_of(4, base=1 + i), max_new_tokens=2, key=i)
+          for i in range(3)]
+    hb = [eng_b.submit(prompt_of(4, base=10), max_new_tokens=2, key=9)]
+    g = telemetry.gauges()
+    # N replicas in one process: the labeled family keeps them apart
+    # (the unlabeled gauge is whichever engine wrote last).
+    assert g[f"serve.queue_depth{{engine={eng_a.engine_id}}}"] == 3
+    assert g[f"serve.queue_depth{{engine={eng_b.engine_id}}}"] == 1
+    for h in ha + hb:
+        h.result()
+    g = telemetry.gauges()
+    assert g[f"serve.queue_depth{{engine={eng_a.engine_id}}}"] == 0
+    assert g[f"serve.queue_depth{{engine={eng_b.engine_id}}}"] == 0
+    # STOPPED prunes the family — absent from the registry, not 0.
+    eng_a.close()
+    g = telemetry.gauges()
+    assert f"serve.queue_depth{{engine={eng_a.engine_id}}}" not in g
+    assert f"serve.queue_depth{{engine={eng_b.engine_id}}}" in g
+    eng_b.close()
+    assert (f"serve.queue_depth{{engine={eng_b.engine_id}}}"
+            not in telemetry.gauges())
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2 + integration: supervision over real engines
+
+
+def test_supervision_reaps_and_prunes_without_manual_poll(family):
+    eng_a = make_engine(family)
+    eng_b = make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    scaler = Autoscaler(
+        router, lambda: make_engine(family),
+        config=AutoscaleConfig(min_replicas=1, max_replicas=3),
+    )
+    reaped = []
+    router.add_reap_listener(lambda rid, eng: reaped.append(eng.engine_id))
+    key_a = f"serve.queue_depth{{engine={eng_a.engine_id}}}"
+    assert key_a in telemetry.gauges()
+    eng_a.close()  # replica dies; nobody calls router.poll()
+    scaler.tick()
+    assert reaped == [eng_a.engine_id]
+    assert [rep.engine for rep in router.replicas()] == [eng_b]
+    assert key_a not in telemetry.gauges()
+    scaler.close()
+    router.close()
+
+
+def test_scale_in_drains_gracefully_real_engines(family):
+    cfg = AutoscaleConfig(
+        min_replicas=1, max_replicas=3, slow_ticks=2,
+        scale_in_cooldown=1, scale_out_cooldown=1,
+    )
+    eng_a = make_engine(family)
+    eng_b = make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    scaler = Autoscaler(router, lambda: make_engine(family), config=cfg)
+    h = router.submit(prompt_of(4), max_new_tokens=3, key=0)
+    assert len(h.result()) == 3
+    for _ in range(20):
+        scaler.tick()
+        if len(router.replicas()) == 1:
+            break
+    assert len(router.replicas()) == 1
+    assert scaler.scale_ins == 1
+    survivor = router.replicas()[0].engine
+    retired = eng_a if survivor is eng_b else eng_b
+    assert retired.health() is Health.STOPPED
+    assert (f"serve.queue_depth{{engine={retired.engine_id}}}"
+            not in telemetry.gauges())
+    # The survivor still serves.
+    h2 = router.submit(prompt_of(4, base=3), max_new_tokens=2, key=1)
+    assert len(h2.result()) == 2
+    scaler.close()
+    router.close()

@@ -17,7 +17,12 @@ same ``submit()/tokens()`` streaming API:
   (zero allocation) and materialized into a standby engine while the
   old version serves, admission flips at a chunk boundary, the old
   engines drain gracefully and retire — no dropped requests, no stream
-  ever mixing two versions.
+  ever mixing two versions;
+* :mod:`.autoscale` — the observe→act control loop: SLO burn signals,
+  occupancy, and a queue-depth-slope predictor drive elastic scale-out
+  (engine factory → ``add_replica``) and scale-in (``begin_drain`` →
+  reap) under hysteresis bands, cooldowns, and min/max bounds, with
+  latched-diverging replicas replaced rather than counted as capacity.
 
 Quick start::
 
@@ -34,6 +39,7 @@ Telemetry: ``fleet.*`` counters/gauges and the ``fleet.swap`` span
 (docs/observability.md).  Full design: docs/fleet.md.
 """
 
+from .autoscale import Autoscaler, AutoscaleConfig  # noqa: F401
 from .hot_swap import hot_swap, materialize_standby  # noqa: F401
 from .router import (  # noqa: F401
     FailoverDiverged,
@@ -45,6 +51,8 @@ from .router import (  # noqa: F401
 )
 
 __all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
     "FailoverDiverged",
     "FailoverExhausted",
     "FleetHandle",

@@ -582,6 +582,11 @@ class FleetRouter:
         self._replicas: Dict[int, Replica] = {}
         self._next_rid = 0
         self._next_key = 0
+        # Supervision hooks (add_reap_listener): notified per replica
+        # reaped by poll() — an autoscaler's control tick runs poll()
+        # so STOPPED replicas leave the fleet (and their gauge families
+        # leave the registry) without user code ever polling by hand.
+        self._reap_listeners: List = []
         self.ops_plane: Optional[_ops.OpsPlane] = None
         if ops_port is None:
             ops_port = _ops.env_ops_port()
@@ -635,6 +640,22 @@ class FleetRouter:
         """Snapshot of the fleet membership (routing order)."""
         return [self._replicas[rid] for rid in sorted(self._replicas)]
 
+    def add_reap_listener(self, fn) -> None:
+        """Register ``fn(rid, engine)``, called by :meth:`poll` for each
+        replica it reaps — the router's supervision hook.  An attached
+        :class:`~torchdistx_tpu.fleet.autoscale.Autoscaler` calls
+        ``poll()`` every control tick, so with one running, STOPPED
+        replicas are reaped (and their per-engine gauge families pruned)
+        with no manual ``poll()`` from user code."""
+        if fn not in self._reap_listeners:
+            self._reap_listeners.append(fn)
+
+    def remove_reap_listener(self, fn) -> None:
+        try:
+            self._reap_listeners.remove(fn)
+        except ValueError:
+            pass
+
     def poll(self) -> List[int]:
         """Reap replicas whose engine reached STOPPED (crashed, closed,
         or drained out).  Their queued/live work already failed with
@@ -645,8 +666,15 @@ class FleetRouter:
             for rid, rep in self._replicas.items()
             if rep.engine.health() is Health.STOPPED
         ]
+        reaped = [(rid, self._replicas[rid].engine) for rid in dead]
         for rid in dead:
             self.remove_replica(rid, close=False)
+        for rid, eng in reaped:
+            for fn in list(self._reap_listeners):
+                try:
+                    fn(rid, eng)
+                except Exception:  # noqa: BLE001 — supervision never kills routing
+                    pass
         return dead
 
     def close(self) -> None:

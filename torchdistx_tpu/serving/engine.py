@@ -537,6 +537,11 @@ class Engine:
             if self._qos
             else FIFOScheduler(max_prefills_per_tick)
         )
+        # Per-engine queue-depth family (serve.queue_depth{engine=...}):
+        # the unlabeled gauge is process-global and N replicas in one
+        # process clobber it — a fleet router or autoscaler must read
+        # the labeled family.  Pruned at STOPPED (_finish_drain).
+        self.scheduler.bind_engine(self.engine_id)
         self.detector = OverloadDetector(max_queue, max_ttft_s)
         self.prefix: Optional[PrefixIndex] = (
             PrefixIndex(block_size) if prefix_cache else None
@@ -1409,6 +1414,10 @@ class Engine:
         # The divergence latch gauge is a dynamic label family: prune it
         # with the engine (the flag itself survives for introspection).
         _telemetry.remove("serve.diverging", engine=self.engine_id)
+        # Same rule for the scheduler's per-engine queue-depth family:
+        # replica churn must not grow /metrics by one series per engine
+        # ever seen.
+        _telemetry.remove("serve.queue_depth", engine=self.engine_id)
         # Time-plane teardown: the tick-phase histogram family and the
         # host-overhead gauge leave the registry with the engine — no
         # serve.tick_phase_s row survives a drain (bounded cardinality

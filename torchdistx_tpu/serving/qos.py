@@ -141,6 +141,7 @@ class QoSScheduler:
         self._n = 0
         self._tenant_n: Dict[str, int] = {}
         self._tenant_gauges: Dict[str, object] = {}
+        self._engine_gauge = None  # serve.queue_depth{engine=}, see bind_engine
 
     # ------------------------------------------------------------------
     # Introspection
@@ -168,8 +169,22 @@ class QoSScheduler:
     # ------------------------------------------------------------------
     # Gauges
 
+    def bind_engine(self, engine_id: str) -> None:
+        """Mint the per-engine ``serve.queue_depth{engine=...}`` gauge
+        (same contract as :meth:`FIFOScheduler.bind_engine
+        <torchdistx_tpu.serving.scheduler.FIFOScheduler.bind_engine>`):
+        the unlabeled gauge is process-global and N replicas clobber it,
+        so a fleet and the autoscaler's slope predictor read the labeled
+        family; the owning engine prunes it at STOPPED."""
+        self._engine_gauge = _telemetry.gauge(
+            "serve.queue_depth", engine=engine_id
+        )
+        self._engine_gauge.set(self._n)
+
     def _set_gauges(self) -> None:
         _G_QUEUE.set(self._n)
+        if self._engine_gauge is not None:
+            self._engine_gauge.set(self._n)
         # Departed tenants (count pruned to zero) leave BOTH the
         # iteration set and the process-wide registry
         # (telemetry.remove): a long-lived engine serving free-form

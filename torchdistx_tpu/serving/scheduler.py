@@ -247,6 +247,26 @@ class FIFOScheduler:
             raise ValueError("max_prefills_per_tick must be >= 1")
         self.max_prefills_per_tick = max_prefills_per_tick
         self._waiting: deque = deque()
+        self._engine_gauge = None  # serve.queue_depth{engine=}, see bind_engine
+
+    def bind_engine(self, engine_id: str) -> None:
+        """Mint the per-engine ``serve.queue_depth{engine=...}`` gauge.
+
+        The unlabeled gauge is process-global: N replicas in one process
+        clobber it (the PR-6 ``serve.health`` bug all over again), so a
+        fleet — and the autoscaler's queue-slope predictor — reads the
+        labeled family instead.  The owning engine calls this right
+        after constructing its scheduler and prunes the family from the
+        registry at STOPPED; a standalone scheduler stays unlabeled."""
+        self._engine_gauge = _telemetry.gauge(
+            "serve.queue_depth", engine=engine_id
+        )
+        self._engine_gauge.set(len(self._waiting))
+
+    def _set_queue_gauge(self, n: int) -> None:
+        _G_QUEUE.set(n)
+        if self._engine_gauge is not None:
+            self._engine_gauge.set(n)
 
     def __len__(self) -> int:
         return len(self._waiting)
@@ -260,14 +280,14 @@ class FIFOScheduler:
 
     def push(self, req: Request) -> None:
         self._waiting.append(req)
-        _G_QUEUE.set(len(self._waiting))
+        self._set_queue_gauge(len(self._waiting))
 
     def requeue(self, reqs: List[Request]) -> None:
         """Return ``reqs`` to the FIFO *head*, preserving their order —
         a transient prefill failure must not cost a request its place."""
         for req in reversed(reqs):
             self._waiting.appendleft(req)
-        _G_QUEUE.set(len(self._waiting))
+        self._set_queue_gauge(len(self._waiting))
 
     def shed_oldest(self) -> Optional[Request]:
         """Pop the oldest waiting request (the ``drop-oldest`` overload
@@ -275,14 +295,14 @@ class FIFOScheduler:
         if not self._waiting:
             return None
         req = self._waiting.popleft()
-        _G_QUEUE.set(len(self._waiting))
+        self._set_queue_gauge(len(self._waiting))
         return req
 
     def flush(self) -> List[Request]:
         """Empty the queue (drain start); returns the flushed requests."""
         out = list(self._waiting)
         self._waiting.clear()
-        _G_QUEUE.set(0)
+        self._set_queue_gauge(0)
         return out
 
     def purge(self, now: float) -> Tuple[List[Request], List[Request]]:
@@ -303,7 +323,7 @@ class FIFOScheduler:
                 keep.append(req)
         if expired or cancelled:
             self._waiting = keep
-            _G_QUEUE.set(len(keep))
+            self._set_queue_gauge(len(keep))
         return expired, cancelled
 
     def pop_admissible(
@@ -344,5 +364,5 @@ class FIFOScheduler:
                 break
             reserved += need
             out.append(self._waiting.popleft())
-        _G_QUEUE.set(len(self._waiting))
+        self._set_queue_gauge(len(self._waiting))
         return out

@@ -425,6 +425,10 @@ class SLOMonitor:
         self._events: Dict[str, _TenantWindows] = {}
         self._burning: Dict[str, bool] = {}
         self._n_seen = 0
+        # Burn-transition listeners (add_burn_listener): consumers of
+        # burn state — an autoscaler, a pager bridge — that COMPOSE with
+        # the primary on_burn callback instead of replacing it.
+        self._listeners: List[Callable[[str, bool, Optional[Dict]], None]] = []
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -439,8 +443,36 @@ class SLOMonitor:
             self._events.clear()
             self._rid_ctx.clear()
             self._burning.clear()
+            self._listeners.clear()
         for tenant in tenants:
             _core.remove("serve.slo_burning", tenant=tenant)
+
+    # -- burn-transition listeners ------------------------------------------
+
+    def add_burn_listener(
+        self, fn: Callable[[str, bool, Optional[Dict[str, Any]]], None]
+    ) -> None:
+        """Register ``fn(tenant, burning, info)`` for burn-state
+        transitions.  Unlike ``SLOConfig.on_burn`` — the PRIMARY
+        callback, which replaces the default flight-dump action —
+        listeners COMPOSE: the primary runs first, then every listener
+        in registration order, so an autoscaler subscribing here never
+        silences the flight recorder.  Listeners see BOTH edges:
+        ``burning=True`` with the burn info dict, and ``burning=False``
+        with ``info=None`` when the tenant genuinely recovers.  A tenant
+        pruned for idleness does NOT emit a recovery edge — no traffic
+        is not evidence the SLO is healthy again — its gauge simply
+        leaves the registry."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_burn_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
 
     # -- the listener -------------------------------------------------------
 
@@ -560,14 +592,24 @@ class SLOMonitor:
         default flight-dump) callback — an ``on_burn`` that reads
         :meth:`summary` must not deadlock the serving thread."""
         _core.gauge("serve.slo_burning", tenant=tenant).set(int(burning))
-        if not burning:
-            return
-        _T_SLO_BURNS.add()
-        cb = self.config.on_burn or self._default_on_burn
-        try:
-            cb(tenant, info)
-        except Exception:  # noqa: BLE001 — monitoring never fails serving
-            pass
+        if burning:
+            _T_SLO_BURNS.add()
+            # The PRIMARY action first (user on_burn replaces the
+            # default flight dump), then the composing listeners — an
+            # autoscaler reacting to the burn must find the dump already
+            # on the ring, not race it.
+            cb = self.config.on_burn or self._default_on_burn
+            try:
+                cb(tenant, info)
+            except Exception:  # noqa: BLE001 — monitoring never fails serving
+                pass
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(tenant, burning, info)
+            except Exception:  # noqa: BLE001 — monitoring never fails serving
+                pass
 
     @staticmethod
     def _default_on_burn(tenant: str, info: Dict[str, Any]) -> None:
@@ -580,6 +622,10 @@ class SLOMonitor:
         _timeplane.fire_profile("slo_burn", tenant=tenant)
 
     def _drop_tenant(self, tenant: str) -> None:
+        # Deliberately NOT a burn transition: a tenant pruned while
+        # burning went idle, it did not recover — listeners (the
+        # autoscaler's cooldown logic) never see a False edge here, and
+        # the gauge is removed rather than zeroed.
         self._events.pop(tenant, None)
         self._burning.pop(tenant, None)
         # Registry prune: an idle tenant's gauge leaves /metrics (and
