@@ -1093,6 +1093,209 @@ def bench_fleet_failover():
     return out
 
 
+def bench_migration():
+    """Stream-migration probe: what the warm hand-off costs vs the cold
+    replay it replaces, and what prefill/decode disaggregation buys the
+    decode tier's tail latency.
+
+    Part 1 — identical decoding streams over a 2-engine fleet, two
+    arms: (a) every stream is warm-migrated engine→engine mid-decode
+    (``router.migrate_stream``: pages shipped, zero recomputed tokens);
+    (b) the source engine is killed instead and the streams take the
+    cold key-pinned replay.  Reports the per-stream hand-off wall time
+    and each arm's consumer-visible p95 pull latency — the
+    migration-vs-replay tax docs/fleet.md's failure matrix argues
+    about.
+
+    Part 2 — decode interference: p95/max inter-token gap of a chatty
+    stream on the decode tier while a 192-token prompt lands, (a)
+    prefilled on the SAME engine (fused baseline: the prefill rides the
+    decode tick loop) vs (b) prefilled on a ``role="prefill"`` peer and
+    warm-migrated in for its decode phase (disaggregated).  Only the
+    chat pulls are timed in both arms — per-tier latency, not
+    whole-host throughput (one host runs both engines here).
+    """
+    import jax
+    import numpy as np
+
+    from torchdistx_tpu.fleet import FleetRouter
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import Engine, RequestError
+
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, dim=512, n_layers=8, n_heads=8, n_kv_heads=8,
+        ffn_dim=2048, max_seq_len=512, remat=False,
+    )
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_engine(role="mixed"):
+        return Engine(
+            params, model=llama, cfg=cfg, num_slots=4, block_size=16,
+            max_model_len=256, decode_chunk=8, min_prefill_bucket=32,
+            handle_preemption=False, role=role,
+        )
+
+    warm = make_engine()
+    wrng = np.random.default_rng(1)
+    for p in (32, 64, 128, 192):
+        warm.submit(
+            wrng.integers(0, cfg.vocab_size, size=p).astype(np.int32),
+            max_new_tokens=4, key=0,
+        )
+    warm.drain()
+    warm.close()
+
+    rng = np.random.default_rng(0)
+    n_req = 4  # one per slot: the whole set decodes (and moves) at once
+    prompts = [
+        rng.integers(
+            0, cfg.vocab_size, size=int(rng.integers(16, 97))
+        ).astype(np.int32)
+        for _ in range(n_req)
+    ]
+    mnts = [int(rng.integers(32, 97)) for _ in range(n_req)]
+
+    def run_arm(kill):
+        eng_a, eng_b = make_engine(), make_engine()
+        router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+        rid_a = next(
+            rid for rid, rep in router._replicas.items()
+            if rep.engine is eng_a
+        )
+        eng_b.detector.observe_tick(50.0)  # pin routing to A
+        handles = []
+        for i, (p, mnt) in enumerate(zip(prompts, mnts)):
+            handles.append(router.submit(p, max_new_tokens=mnt, key=i))
+            eng_b.detector.observe_tick(50.0)
+        for _ in range(10_000):
+            if (
+                not len(eng_a.scheduler)
+                and eng_a._n_running()
+                and eng_a._n_running() == eng_a._n_decoding()
+            ):
+                break
+            eng_a.step()
+        hand_off = []
+        if kill:
+            for leaf in jax.tree.leaves(eng_a._cache):
+                leaf.delete()
+            eng_a.close()
+            router.poll()
+        else:
+            for slot in list(eng_a.migratable_slots()):
+                t0 = time.perf_counter()
+                if router.migrate_stream(rid_a, slot):
+                    hand_off.append(time.perf_counter() - t0)
+        lats, n_done = [], 0
+        for h in handles:
+            t0 = time.perf_counter()
+            try:
+                h.result()
+                n_done += 1
+            except RequestError:
+                pass
+            lats.append(time.perf_counter() - t0)
+        router.close()
+        return n_done, lats, hand_off
+
+    n_mig_done, mig_lats, hand_off = run_arm(kill=False)
+    n_cold_done, cold_lats, _ = run_arm(kill=True)
+
+    out = {
+        "n_streams": n_req,
+        # Both arms must complete everything — warm or cold, no stream
+        # is ever lost.
+        "migrated_completed": n_mig_done,
+        "cold_replay_completed": n_cold_done,
+        "migrated_pull_p95_s": round(
+            float(np.percentile(mig_lats, 95)), 4
+        ),
+        "cold_replay_pull_p95_s": round(
+            float(np.percentile(cold_lats, 95)), 4
+        ),
+        "migration_saved_p95_s": round(
+            float(np.percentile(cold_lats, 95))
+            - float(np.percentile(mig_lats, 95)),
+            4,
+        ),
+    }
+    if hand_off:
+        out["migration_handoff_p95_s"] = round(
+            float(np.percentile(hand_off, 95)), 4
+        )
+
+    # ---- Part 2: decode-tier tail while a long prompt lands ----
+    chat_prompt = rng.integers(0, cfg.vocab_size, size=12).astype(np.int32)
+    long_prompt = rng.integers(0, cfg.vocab_size, size=192).astype(np.int32)
+    CHAT_NEW, LONG_AT = 48, 8
+
+    def chat_gaps(pull_iter, on_token):
+        gaps, last = [], time.perf_counter()
+        for i, _tok in enumerate(pull_iter):
+            gaps.append(time.perf_counter() - last)
+            on_token(i)
+            last = time.perf_counter()  # driver work stays untimed
+        return gaps
+
+    # (a) fused: the long prefill rides the chat stream's engine.
+    eng = make_engine()
+    chat = eng.submit(chat_prompt, max_new_tokens=CHAT_NEW, key=100)
+    pending = {}
+
+    def fused_on_token(i):
+        if i == LONG_AT:
+            pending["h"] = eng.submit(
+                long_prompt, max_new_tokens=8, key=101
+            )
+
+    fused = chat_gaps(chat.tokens(), fused_on_token)
+    if "h" in pending:
+        while not pending["h"].done:
+            eng.step()
+    eng.close()
+
+    # (b) disaggregated: the long prompt prefills on the prefill peer
+    # and warm-migrates in for its decode phase.
+    eng_p, eng_d = make_engine("prefill"), make_engine("decode")
+    router = FleetRouter(
+        [eng_p, eng_d], version="v1", max_hops=4, long_prompt_tokens=128,
+    )
+    chat = router.submit(chat_prompt, max_new_tokens=CHAT_NEW, key=100)
+    state = {}
+
+    def disagg_on_token(i):
+        if i == LONG_AT:
+            state["h"] = router.submit(
+                long_prompt, max_new_tokens=8, key=101
+            )
+        elif "h" in state and not state["h"].done:
+            eng_p.step()  # the prefill tier does its own work
+            router.rebalance()  # decode-phase streams ship over
+
+    disagg = chat_gaps(chat.tokens(), disagg_on_token)
+    if "h" in state:
+        try:
+            state["h"].result()
+        except RequestError:
+            pass
+    router.close()
+
+    # gaps[0] is the chat TTFT (queue + its own prefill) — TPOT starts
+    # at the second token in both arms.
+    out["fused_chat_tpot_p95_ms"] = round(
+        float(np.percentile(fused[1:], 95)) * 1e3, 2
+    )
+    out["disagg_chat_tpot_p95_ms"] = round(
+        float(np.percentile(disagg[1:], 95)) * 1e3, 2
+    )
+    out["disagg_tpot_saved_p95_ms"] = round(
+        out["fused_chat_tpot_p95_ms"] - out["disagg_chat_tpot_p95_ms"], 2
+    )
+    out["fused_chat_tpot_max_ms"] = round(max(fused[1:]) * 1e3, 2)
+    out["disagg_chat_tpot_max_ms"] = round(max(disagg[1:]) * 1e3, 2)
+    return out
+
+
 def bench_autoscale():
     """Elastic fleet probe: what the observe→act loop buys in a flash
     crowd.
@@ -1413,6 +1616,10 @@ def main():
         autoscale = bench_autoscale()
     except Exception as e:  # noqa: BLE001
         autoscale = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        migration = bench_migration()
+    except Exception as e:  # noqa: BLE001
+        migration = {"error": f"{type(e).__name__}: {e}"}
     # Second flash probe, minutes after the first (same compiled program,
     # deterministic work): tunnel windows last minutes, so two temporally
     # separated samples of the same measurement keep one bad window from
@@ -1459,6 +1666,7 @@ def main():
                     "serving_llama_350m_continuous": serving,
                     "fleet_failover": fleet,
                     "fleet_autoscale": autoscale,
+                    "fleet_migration": migration,
                     "cold_uncached_s": cold,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),

@@ -81,6 +81,19 @@ METRICS: List[Tuple[str, Tuple[str, ...], bool, float]] = [
      ("details", "fleet_autoscale", "ramp_ttft_p95_s"), False, 0.50),
     ("autoscale_dropped",
      ("details", "fleet_autoscale", "dropped"), False, 0.0),
+    # Stream migration (ISSUE 17): the warm hand-off's wall time, the
+    # consumer-visible p95 pull latency of a migrated stream (must stay
+    # well under the cold-replay arm's), and the decode tier's p95
+    # inter-token gap while a long prompt lands on the prefill peer.
+    # All gate vacuously (no_baseline) until a round records them.
+    ("migration_handoff_p95_s",
+     ("details", "fleet_migration", "migration_handoff_p95_s"),
+     False, 0.60),
+    ("migration_pull_p95_s",
+     ("details", "fleet_migration", "migrated_pull_p95_s"), False, 0.50),
+    ("migration_disagg_tpot_p95_ms",
+     ("details", "fleet_migration", "disagg_chat_tpot_p95_ms"),
+     False, 0.50),
 ]
 
 

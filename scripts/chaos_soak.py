@@ -86,6 +86,23 @@ the final trace assertion gates ``audit.checked >= 1`` AND
 failovers, and swaps all replay token-identically must ALSO re-execute
 divergence-free at 100% sampling.
 
+**Migration mode** (``python scripts/chaos_soak.py migration``, ISSUE
+17 acceptance gate): live KV-page stream migration under chaos.  A
+**role-split fleet** (one ``role="prefill"`` + one ``role="decode"``
+engine) serves a long-prompt + chatty mix while ``router.step()``'s
+rebalance ships decode phases across engines mid-stream; a
+**drain-by-migration** scale-in drill empties a replica with zero
+recomputed prefill tokens; an injected ``serve.migrate_in`` io fault
+forces a **verified fallback-to-replay** (the stream still completes
+token-identical); and an engine **killed mid-migration** (pool
+deleted before the export can run) proves cold replay remains the
+path when the source pool is gone.  Gates: zero requests lost
+untyped, ``audit.divergences == 0`` at 100% sampling, zero leaked
+pages / refcount drift on every replica, and the exported trace shows
+the ``serve.migrate_out``/``serve.migrate_in`` spans,
+``fleet.migrations >= 2``, and at least one ``req.migration_fallback``
+event (``fleet.migration_fallbacks >= 1``).
+
 **Autoscale mode** (``python scripts/chaos_soak.py autoscale``, ISSUE
 16 acceptance gate): the observe→act loop under chaos.  A
 :class:`~torchdistx_tpu.fleet.Autoscaler` owns a QoS fleet (min 1, max
@@ -109,7 +126,7 @@ autoscale-chaos jobs) runs all modes with ``TDX_TELEMETRY`` set.
 Locally:
 
     TDX_TELEMETRY=/tmp/chaos.jsonl JAX_PLATFORMS=cpu \\
-    python scripts/chaos_soak.py [fleet|autoscale]
+    python scripts/chaos_soak.py [fleet|migration|autoscale]
 """
 
 import json
@@ -1216,6 +1233,379 @@ def fleet_main() -> int:
     return 0
 
 
+def migration_main() -> int:
+    """Stream-migration chaos (ISSUE 17): role-split fleet with live
+    prefill→decode handoffs, drain-by-migration, a verified
+    fallback-to-replay, and an engine killed before its export — zero
+    silent loss, zero recompute on the happy path, zero leaked pages."""
+    trace = os.environ.get("TDX_TELEMETRY", "")
+    if not trace:
+        print("chaos_soak: set TDX_TELEMETRY", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.fleet import FleetRouter
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.models.generate import generate
+    from torchdistx_tpu.resilience import faults
+    from torchdistx_tpu.serving import (
+        DeadlineExceeded,
+        Engine,
+        Health,
+        RequestCancelled,
+        RequestError,
+    )
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(SEED)
+
+    solo_cache = {}
+
+    def solo(prompt, key, max_new, temperature=0.0, top_k=None):
+        k = (prompt.tobytes(), key, max_new, temperature, top_k)
+        if k not in solo_cache:
+            toks = [
+                int(t) for t in np.asarray(
+                    generate(
+                        params, prompt[None], jax.random.PRNGKey(key),
+                        model=llama, cfg=cfg, max_new_tokens=max_new,
+                        eos_id=EOS, temperature=temperature, top_k=top_k,
+                    )
+                )[0]
+            ]
+            if EOS in toks:
+                toks = toks[: toks.index(EOS) + 1]
+            solo_cache[k] = toks
+        return solo_cache[k]
+
+    def make_engine(role="mixed", temperature=0.0, top_k=None):
+        return Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+            block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+            temperature=temperature, top_k=top_k, drain_deadline_s=120.0,
+            handle_preemption=False, role=role,
+        )
+
+    def settle(label, engines, step):
+        """Drain audit backlogs (bounded), then leak-check every
+        engine: pages in use == indexed prefixes, refcounts clean, no
+        phantom swapped pages left behind by a migration."""
+        for _ in range(MAX_STEPS):
+            live = [e for e in engines if e.health() is not Health.STOPPED]
+            if not any(
+                len(e.scheduler) or e._n_running() or e.audit_backlog()
+                for e in live
+            ):
+                break
+            step()
+        else:
+            return f"[{label}] audit backlog did not drain (hang)"
+        for e in engines:
+            indexed = (
+                len(e.prefix)
+                if e.prefix is not None and e.health() is not Health.STOPPED
+                else 0
+            )
+            if e.allocator.num_in_use != indexed:
+                return (
+                    f"[{label}] engine {e.engine_id} leaked "
+                    f"{e.allocator.num_in_use} pages ({indexed} indexed)"
+                )
+            if indexed:
+                drift = e.prefix.check(e.allocator)
+                if drift is not None:
+                    return (
+                        f"[{label}] engine {e.engine_id} refcount "
+                        f"drift: {drift}"
+                    )
+            if e.allocator.num_swapped:
+                return (
+                    f"[{label}] engine {e.engine_id} left "
+                    f"{e.allocator.num_swapped} phantom swapped pages"
+                )
+        return None
+
+    def check(label, reqs, temperature=0.0, top_k=None):
+        """Every handle finished token-identical or failed with the
+        client's own typed deadline/cancel; returns (n_ok, n_typed) or
+        an error string."""
+        n_ok = n_typed = 0
+        for prompt, mnt, key, h in reqs:
+            if not h.done:
+                return f"[{label}] request {key} neither finished nor failed"
+            if h.error is not None:
+                if not isinstance(h.error, RequestError):
+                    return (
+                        f"[{label}] request {key} failed UNTYPED: "
+                        f"{type(h.error).__name__}: {h.error}"
+                    )
+                if not isinstance(
+                    h.error, (DeadlineExceeded, RequestCancelled)
+                ):
+                    return (
+                        f"[{label}] request {key} lost to infrastructure: "
+                        f"{h.error!r}"
+                    )
+                n_typed += 1
+            else:
+                if h.result() != solo(prompt, key, mnt, temperature, top_k):
+                    return (
+                        f"[{label}] request {key} diverged from solo "
+                        "generate()"
+                    )
+                n_ok += 1
+        return n_ok, n_typed
+
+    # ---------------- Phase 1: role split + live handoff ----------------
+    # A prefill-role and a decode-role replica under a long-prompt +
+    # chatty mix: the router steers long prompts to the prefill replica,
+    # and every router.step() rebalances decode-phase streams onto the
+    # decode replica mid-stream — pages shipped, zero recomputed tokens.
+    eng_p = make_engine(role="prefill")
+    eng_d = make_engine(role="decode")
+    router = FleetRouter(
+        [eng_p, eng_d], version="v1", max_hops=4, long_prompt_tokens=16,
+    )
+    n = max(8, N_REQUESTS // 8)
+    reqs = []
+    for i in range(n):
+        if rng.random() < 0.5:
+            plen = int(rng.integers(16, 29))  # long: steered to prefill
+        else:
+            plen = int(rng.integers(3, 9))  # chatty: steered off prefill
+        prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        mnt = int(rng.choice((4, 8, 12)))
+        deadline = None if rng.random() > 0.05 else 1e-6
+        h = router.submit(
+            prompt, max_new_tokens=mnt, key=30_000 + i, deadline_s=deadline,
+        )
+        if rng.random() < 0.05:
+            h.cancel()
+        reqs.append((prompt, mnt, 30_000 + i, h))
+    for _, _, _, h in reqs:
+        # step() runs the engines AND the prefill→decode rebalance;
+        # interleaving it with the pulls ships decode-phase streams off
+        # the prefill replica at many different fleet states.  The
+        # pulls themselves drive the bound engine (as in fleet_main).
+        router.step()
+        try:
+            h.result()
+        except RequestError:
+            pass
+    res = check("roles", reqs)
+    if isinstance(res, str):
+        return fail(res)
+    err = settle("roles", [eng_p, eng_d], router.step)
+    if err is not None:
+        return fail(err)
+    n_moved = telemetry.counter("fleet.migrations").value
+    if n_moved < 1:
+        return fail("role-split phase produced no prefill→decode handoff")
+    roles = sorted(r["role"] for r in router.stats()["replicas"])
+    if roles != ["decode", "prefill"]:
+        return fail(f"[roles] fleet roles wrong: {roles}")
+    router.close()
+    print(
+        f"chaos_soak: migration roles OK — {res[0]} token-identical, "
+        f"{res[1]} typed deadline/cancel, {n_moved} handoffs"
+    )
+
+    # ---------------- Phase 2: drain-by-migration ----------------
+    # Scale-in drill: close admission, migrate every live stream out,
+    # and the drain completes with the streams finishing on the peer —
+    # zero recomputed prefill tokens (no crash-recovery replays).
+    eng_a = make_engine(temperature=0.7, top_k=8)
+    eng_b = make_engine(temperature=0.7, top_k=8)
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+    eng_b.detector.observe_tick(50.0)  # pin routing to A
+    reqs = []
+    for i in range(3):
+        prompt = rng.integers(0, cfg.vocab_size, size=6 + i).astype(np.int32)
+        h = router.submit(prompt, max_new_tokens=12, key=31_000 + i)
+        reqs.append((prompt, 12, 31_000 + i, h))
+        eng_b.detector.observe_tick(50.0)
+    for _ in range(MAX_STEPS):
+        # Wait until EVERY stream is in its decode phase (admitted and
+        # past prefill) so the whole set is migratable at once.
+        if (
+            not len(eng_a.scheduler)
+            and eng_a._n_running()
+            and eng_a._n_running() == eng_a._n_decoding()
+        ):
+            break
+        eng_a.step()
+    else:
+        return fail("[drain] streams never all reached their decode phase")
+    rid_a = next(
+        rid for rid, rep in router._replicas.items() if rep.engine is eng_a
+    )
+    router.close_admission(rid_a)
+    # A stream can legitimately hit EOS during the warm-up; migrate
+    # whatever is still live, and all of it must move.
+    n_live = len(list(eng_a.migratable_slots()))
+    out = router.migrate_out_streams(rid_a)
+    if out["migrated"] != n_live or n_live < 1 or out["fallbacks"] or out["left"]:
+        return fail(f"[drain] migrate_out_streams: {out} (live={n_live})")
+    for *_, h in reqs:
+        try:
+            h.result()  # pulls now drive the PEER — the streams moved
+        except RequestError:
+            pass
+    res = check("drain", reqs, temperature=0.7, top_k=8)
+    if isinstance(res, str):
+        return fail(res)
+    if eng_b.stats()["recoveries"]:
+        return fail("[drain] peer recomputed a migrated stream (replays>0)")
+    eng_a.begin_drain()
+    for _ in range(MAX_STEPS):
+        if eng_a.health() is Health.STOPPED:
+            break
+        router.step()
+    else:
+        return fail("[drain] emptied replica did not reach STOPPED")
+    err = settle("drain", [eng_a, eng_b], router.step)
+    if err is not None:
+        return fail(err)
+    router.close()
+    print(
+        f"chaos_soak: migration drain OK — {out['migrated']} streams "
+        f"migrated, {res[0]} finished on the peer, zero recompute"
+    )
+
+    # ---------------- Phase 3: verified fallback-to-replay ----------------
+    # An injected io fault on the import side: the destination frees its
+    # partial page set, the source slot is already gone, and the stream
+    # must still complete token-identical via the cold key-pinned replay.
+    eng_a = make_engine()
+    eng_b = make_engine()
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+    eng_b.detector.observe_tick(50.0)
+    prompt = rng.integers(0, cfg.vocab_size, size=9).astype(np.int32)
+    h = router.submit(prompt, max_new_tokens=10, key=32_000)
+    for _ in range(MAX_STEPS):
+        if eng_a._n_decoding():
+            break
+        eng_a.step()
+    rid_a = next(
+        rid for rid, rep in router._replicas.items() if rep.engine is eng_a
+    )
+    slot = next(iter(eng_a.migratable_slots()))
+    before = telemetry.counter("fleet.migration_fallbacks").value
+    faults.reset("serve.migrate_in:1:io")
+    try:
+        if router.migrate_stream(rid_a, slot):
+            return fail("[fallback] migration succeeded through an io fault")
+    finally:
+        faults.reset("")
+    if telemetry.counter("fleet.migration_fallbacks").value != before + 1:
+        return fail("[fallback] fleet.migration_fallbacks did not advance")
+    if eng_b.allocator.num_in_use != (
+        len(eng_b.prefix) if eng_b.prefix is not None else 0
+    ):
+        return fail("[fallback] import fault leaked pages on the destination")
+    try:
+        h.result()  # the pull catches the retryable preemption → replay
+    except RequestError:
+        pass
+    res = check("fallback", [(prompt, 10, 32_000, h)])
+    if isinstance(res, str):
+        return fail(res)
+    if res[0] != 1:
+        return fail("[fallback] stream did not complete after the replay")
+    if h.hops < 1:
+        return fail("[fallback] stream completed without a replay hop")
+    err = settle("fallback", [eng_a, eng_b], router.step)
+    if err is not None:
+        return fail(err)
+    router.close()
+    print("chaos_soak: migration fallback OK — io fault on import, "
+          "destination clean, stream replayed token-identical")
+
+    # ---------------- Phase 4: kill mid-migration ----------------
+    # The source pool dies before the export can run: migrate_stream
+    # declines (the export must never strand a stream it cannot move),
+    # and closing the dead replica routes the stream through the normal
+    # cold-replay failover — migration never replaces that last resort.
+    eng_a = make_engine()
+    eng_b = make_engine()
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=4)
+    eng_b.detector.observe_tick(50.0)
+    prompt = rng.integers(0, cfg.vocab_size, size=7).astype(np.int32)
+    h = router.submit(prompt, max_new_tokens=8, key=33_000)
+    for _ in range(MAX_STEPS):
+        if eng_a._n_decoding():
+            break
+        eng_a.step()
+    rid_a = next(
+        rid for rid, rep in router._replicas.items() if rep.engine is eng_a
+    )
+    slot = next(iter(eng_a.migratable_slots()))
+    for leaf in jax.tree.leaves(eng_a._cache):
+        leaf.delete()
+    if router.migrate_stream(rid_a, slot):
+        return fail("[kill] migration claimed success off a dead pool")
+    eng_a.close()
+    router.poll()
+    try:
+        h.result()  # close() failed the stream retryably → cold replay
+    except RequestError:
+        pass
+    res = check("kill", [(prompt, 8, 33_000, h)])
+    if isinstance(res, str):
+        return fail(res)
+    if res[0] != 1 or h.hops < 1:
+        return fail("[kill] stream did not cold-replay off the dead replica")
+    err = settle("kill", [eng_b], router.step)
+    if err is not None:
+        return fail(err)
+    router.close()
+    print("chaos_soak: migration kill OK — dead pool declined the export, "
+          "stream cold-replayed on the peer")
+
+    # ---------------- Trace assertions ----------------
+    telemetry.emit_counters()
+    spans, counters, dumps, events = parse_trace(trace)
+    missing = {"serve.migrate_out", "serve.migrate_in"} - spans
+    if missing:
+        return fail(f"trace missing spans {missing}")
+    if counters.get("fleet.migrations", 0) < 2:
+        return fail(
+            f"trace shows fleet.migrations="
+            f"{counters.get('fleet.migrations', 0)} < 2"
+        )
+    if counters.get("fleet.migration_fallbacks", 0) < 1:
+        return fail("trace shows no fleet.migration_fallbacks")
+    if not events.get("req.migration_fallback"):
+        return fail("trace has no req.migration_fallback event")
+    if counters.get("serve.migrated_pages", 0) < 1:
+        return fail("trace shows no serve.migrated_pages")
+    if AUDITING:
+        if counters.get("audit.checked", 0) < 1:
+            return fail(
+                "TDX_AUDIT_SAMPLE set but the migration trace shows no "
+                "audit.checked"
+            )
+        if counters.get("audit.divergences", 0) != 0:
+            return fail(
+                f"audit.divergences = {counters.get('audit.divergences')} "
+                "!= 0 in the migration soak"
+            )
+    print(
+        "chaos_soak: migration trace OK — "
+        f"migrations={counters.get('fleet.migrations')}, "
+        f"fallbacks={counters.get('fleet.migration_fallbacks')}, "
+        f"migrated_pages={counters.get('serve.migrated_pages')}, "
+        f"audit.checked={counters.get('audit.checked', 0)}"
+    )
+    return 0
+
+
 def autoscale_main() -> int:
     """Autoscale chaos (ISSUE 16): flash crowd, diurnal ramp, runaway
     tenant — the autoscaler must recover the SLO burn autonomously,
@@ -1564,6 +1954,8 @@ def autoscale_main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         sys.exit(fleet_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "migration":
+        sys.exit(migration_main())
     if len(sys.argv) > 1 and sys.argv[1] == "autoscale":
         sys.exit(autoscale_main())
     sys.exit(main())

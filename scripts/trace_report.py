@@ -4,6 +4,7 @@
 The serving/fleet stack emits a request-scoped lifecycle event stream
 (``req.submitted → req.queued → req.admitted → req.prefill_chunk×N →
 req.first_token → req.preempted/req.swapped/req.resumed →
+req.migrated_out/req.migrated_in/req.migration_fallback →
 req.failover_hop → req.finished | req.failed``) where every event — and
 every ``serve.*`` span started inside the request's trace scope —
 carries the same ``rid`` (trace id), the ``engine`` that emitted it, and
@@ -12,7 +13,8 @@ tracing").  This analyzer groups a trace (chaos soak, bench, or
 production) by ``rid`` and answers "where did this request's time go":
 
 * a **phase breakdown** per request — queue wait, prefill, decode,
-  preemption outage, failover — attributed interval-by-interval between
+  preemption outage, migration transit, failover — attributed
+  interval-by-interval between
   consecutive events, so the phases sum to the request's wall time
   (anything between events this tool does not recognize lands in
   ``unaccounted`` instead of silently inflating a known phase);
@@ -65,8 +67,18 @@ _STATE_AFTER = {
     "req.preempted": "preempt",
     "req.swapped": "preempt",
     "req.failover_hop": "queue",  # placed on the peer; waiting to admit
+    # Stream migration (docs/fleet.md, "Disaggregation & stream
+    # migration"): pages in transit between the export and the import;
+    # a fallback means the snapshot was dropped and the stream is down
+    # until the cold replay re-places it — a failover outage.
+    "req.migrated_out": "migrate",
+    "req.migrated_in": "decode",
+    "req.migration_fallback": "failover",
 }
-PHASES = ("queue", "prefill", "decode", "preempt", "failover", "unaccounted")
+PHASES = (
+    "queue", "prefill", "decode", "preempt", "migrate", "failover",
+    "unaccounted",
+)
 _TERMINAL = ("req.finished", "req.failed")
 
 
@@ -370,6 +382,7 @@ def _fmt_row(s: Dict[str, Any]) -> str:
         f"total={ph.get('total', 0.0):7.3f}s  "
         f"q={ph['queue']:6.3f} pf={ph['prefill']:6.3f} "
         f"dec={ph['decode']:6.3f} pre={ph['preempt']:6.3f} "
+        f"mig={ph['migrate']:6.3f} "
         f"fo={ph['failover']:6.3f} ?={ph['unaccounted']:6.3f}"
     )
 

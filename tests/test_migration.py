@@ -1,0 +1,395 @@
+"""Live KV-page stream migration (ISSUE 17): warm failover, zero-recompute
+drain, prefill/decode disaggregation.
+
+A live decoding stream must move between same-version engines at the
+KV-page level — pages gathered to host on the source, digest-verified
+and scattered on the destination, the same ``fold_in(key, n_gen)``
+schedule continuing — with not one token recomputed, lost, or changed
+(greedy AND sampled, prefix cache on AND off).  Shared/CoW prefix pages
+migrate as a self-contained private set; refcounts settle to exactly
+the index-owned set on the source and a private set on the destination
+— zero leaked pages, zero phantom swapped pages, on both engines.
+Incompatible imports fail typed (``MigrationIncompatible``) BEFORE any
+scatter; injected faults at ``serve.migrate_out`` leave the source
+stream running untouched, at ``serve.migrate_in`` free the partial page
+set and fall back to the cold key-pinned replay.  A stream whose
+deadline expires mid-migration surfaces ``DeadlineExceeded`` once.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.fleet import FleetRouter
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.models.generate import generate
+from torchdistx_tpu.resilience import faults, preemption
+from torchdistx_tpu.serving import (
+    DeadlineExceeded,
+    Engine,
+    Health,
+    MigrationIncompatible,
+    RequestPreempted,
+)
+
+EOS = 5
+ENGINE_KW = dict(
+    num_slots=2, block_size=8, max_model_len=64, decode_chunk=4,
+    handle_preemption=False, prefix_cache=False,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    preemption.clear()
+    yield
+    preemption.clear()
+    faults.reset("")
+
+
+@pytest.fixture(scope="module")
+def family():
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return llama, cfg, params
+
+
+def solo(family, prompt, seed, max_new, *, eos=None, temperature=0.0,
+         top_k=None):
+    model, cfg, params = family
+    out = generate(
+        params, jnp.asarray(prompt)[None], jax.random.PRNGKey(seed),
+        model=model, cfg=cfg, max_new_tokens=max_new, eos_id=eos,
+        temperature=temperature, top_k=top_k,
+    )
+    toks = [int(t) for t in np.asarray(out)[0]]
+    if eos is not None and eos in toks:
+        toks = toks[: toks.index(eos) + 1]
+    return toks
+
+
+def prompt_of(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def make_engine(family, **over):
+    model, cfg, params = family
+    kw = {**ENGINE_KW, **over}
+    return Engine(params, model=model, cfg=cfg, **kw)
+
+
+def settled(eng):
+    """Zero leaked pages: only index-owned pages remain in use, nothing
+    phantom-swapped."""
+    held = 0 if eng.prefix is None else len(eng.prefix)
+    return (
+        eng.allocator.num_in_use == held and eng.allocator.num_swapped == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Warm migration: token parity across the engine hop
+
+
+@pytest.mark.parametrize(
+    "temperature,top_k", [(0.0, None), (0.8, 8)], ids=["greedy", "sampled"]
+)
+@pytest.mark.parametrize("cache", [False, True], ids=["nocache", "cache"])
+def test_migration_token_identical(family, temperature, top_k, cache):
+    """The tentpole invariant: a stream migrated mid-decode continues on
+    the peer token-identically — zero recompute, zero divergence —
+    greedy and sampled, prefix cache on and off."""
+    kw = dict(temperature=temperature, top_k=top_k, eos_id=EOS,
+              prefix_cache=cache)
+    eng_a, eng_b = make_engine(family, **kw), make_engine(family, **kw)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    before = telemetry.counter("fleet.migrations").value
+    h = router.submit(prompt_of(6), max_new_tokens=10, key=3)
+    src = h.replica_id
+    src_eng = eng_a if src == 0 else eng_b
+    dst_eng = eng_b if src == 0 else eng_a
+    g = h.tokens()
+    first = [next(g), next(g)]
+    (slot,) = src_eng.migratable_slots()
+    assert router.migrate_stream(src, slot)
+    rest = list(g)
+    expect = solo(family, prompt_of(6), 3, 10, eos=EOS,
+                  temperature=temperature, top_k=top_k)
+    assert first + rest == expect
+    assert telemetry.counter("fleet.migrations").value == before + 1
+    assert src_eng.stats()["migrations_out"] == 1
+    assert dst_eng.stats()["migrations_in"] == 1
+    assert src_eng.stats()["recoveries"] == 0  # zero recompute
+    assert dst_eng.stats()["recoveries"] == 0
+    assert settled(eng_a) and settled(eng_b)
+
+
+def test_shared_prefix_pages_migrate_and_refcounts_settle(family):
+    """A prefix-cached stream holds SHARED (CoW) pages; its migration
+    must resolve them into a self-contained private set on the
+    destination while the source settles to exactly the index-owned
+    pages (refcount 1 each) — no leak, no phantom swap, either side."""
+    eng_a = make_engine(family, prefix_cache=True)
+    eng_b = make_engine(family, prefix_cache=True)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    prompt = prompt_of(16)  # two full pages at block_size=8: indexable
+    # Warm A's prefix index (route there explicitly), and pin routing so
+    # the second submission shares its pages.
+    eng_b.detector.observe_tick(50.0)
+    warm = router.submit(prompt, max_new_tokens=2, key=0)
+    assert warm.replica_id == 0 and len(warm.result()) == 2
+    assert len(eng_a.prefix) == 2
+    eng_b.detector.observe_tick(50.0)  # A's real ticks must stay cheaper
+    h = router.submit(prompt, max_new_tokens=8, key=1)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    (slot,) = eng_a.migratable_slots()
+    req = eng_a._slot_req[slot]
+    shared = [p for p in req.blocks if eng_a.allocator.refcount(p) > 1]
+    assert shared, "the stream must actually hold shared prefix pages"
+    assert router.migrate_stream(0, slot)
+    # Source: the stream's refs dropped; the index-owned set remains,
+    # every page at exactly refcount 1.
+    assert eng_a.allocator.num_in_use == len(eng_a.prefix) == 2
+    assert all(
+        eng_a.allocator.refcount(p) == 1 for p in eng_a.prefix._pages.values()
+    )
+    assert eng_a.allocator.num_swapped == 0
+    # Destination: a fully private copy — every page refcount 1, none
+    # known to B's (empty) index.
+    assert len(eng_b.prefix) == 0
+    assert all(eng_b.allocator.refcount(p) == 1 for p in req.blocks)
+    assert eng_b.allocator.num_swapped == 0
+    rest = list(g)
+    assert first + rest == solo(family, prompt, 1, 8)
+    assert settled(eng_a) and settled(eng_b)
+
+
+def test_drain_by_migration(family):
+    """Graceful scale-in/hot-swap drain: migrate_out_streams empties a
+    replica with zero recomputed tokens; the drain then completes
+    immediately and the stream finishes on the peer."""
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    eng_b.detector.observe_tick(0.5)
+    h = router.submit(prompt_of(6), max_new_tokens=10, key=7)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    router.close_admission(0)
+    out = router.migrate_out_streams(0)
+    assert out == {"migrated": 1, "fallbacks": 0, "left": 0}
+    eng_a.begin_drain()
+    while eng_a.health() is not Health.STOPPED:
+        eng_a.step()
+    rest = list(g)
+    assert first + rest == solo(family, prompt_of(6), 7, 10)
+    assert eng_b.stats()["migrations_in"] == 1
+    assert eng_b.stats()["recoveries"] == 0
+    assert settled(eng_b)
+
+
+# ---------------------------------------------------------------------------
+# Typed incompatibility + fallback-to-replay
+
+
+def test_geometry_mismatch_typed_before_scatter(family):
+    """An incompatible snapshot must be rejected BEFORE any page
+    scatter — typed, destination pool untouched."""
+    eng_a = make_engine(family)
+    eng_b = make_engine(family, block_size=16)  # incompatible geometry
+    h = eng_a.submit(prompt_of(6), max_new_tokens=8, key=0)
+    g = h.tokens()
+    next(g)
+    (slot,) = eng_a.migratable_slots()
+    snapshot = eng_a.migrate_out(slot)
+    with pytest.raises(MigrationIncompatible) as ei:
+        eng_b.migrate_in(snapshot)
+    assert ei.value.retryable
+    assert eng_b.allocator.num_in_use == 0  # nothing allocated, no leak
+    assert not h.done  # the stream is fine — a cold replay reproduces it
+
+
+def test_incompatible_import_falls_back_to_cold_replay(family):
+    """Router path: export succeeds, every candidate refuses the import
+    → the stream falls back to the key-pinned cold replay, counted on
+    fleet.migration_fallbacks, still token-identical."""
+    eng_a = make_engine(family)
+    eng_b = make_engine(family, block_size=16)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    eng_b.detector.observe_tick(0.5)
+    before = telemetry.counter("fleet.migration_fallbacks").value
+    h = router.submit(prompt_of(6), max_new_tokens=10, key=9)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    (slot,) = eng_a.migratable_slots()
+    assert not router.migrate_stream(0, slot)
+    assert telemetry.counter("fleet.migration_fallbacks").value == before + 1
+    inner_err = h._inner.error
+    assert isinstance(inner_err, RequestPreempted) and inner_err.retryable
+    rest = list(g)  # the FleetHandle re-binds and replays
+    assert first + rest == solo(family, prompt_of(6), 9, 10)
+    assert h.hops == 1
+    assert settled(eng_a) and settled(eng_b)
+
+
+def test_version_pinned_no_destination_leaves_stream_running(family):
+    """Migration is version-pinned like failover: with no same-version
+    peer the stream is skipped — left running, never failed."""
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a], version="v1")
+    router.add_replica(eng_b, version="v2")
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=2)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    (slot,) = eng_a.migratable_slots()
+    assert not router.migrate_stream(0, slot)
+    rest = list(g)  # untouched: finishes on the source
+    assert first + rest == solo(family, prompt_of(6), 2, 8)
+    assert eng_a.stats()["migrations_out"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Fault injection at the migration sites
+
+
+def test_fault_migrate_out_leaves_source_untouched(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    eng_b.detector.observe_tick(0.5)
+    faults.reset("serve.migrate_out:1:io")
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=4)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    (slot,) = eng_a.migratable_slots()
+    assert not router.migrate_stream(0, slot)
+    rest = list(g)  # still on A, still token-identical
+    assert first + rest == solo(family, prompt_of(6), 4, 8)
+    assert h.hops == 0
+    assert eng_a.stats()["migrations_out"] == 0
+    assert eng_b.stats()["migrations_in"] == 0
+    assert settled(eng_a) and settled(eng_b)
+
+
+def test_fault_migrate_in_frees_pages_and_falls_back(family):
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    eng_b.detector.observe_tick(0.5)
+    faults.reset("serve.migrate_in:1:io")
+    before = telemetry.counter("fleet.migration_fallbacks").value
+    h = router.submit(prompt_of(6), max_new_tokens=10, key=6)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    (slot,) = eng_a.migratable_slots()
+    assert not router.migrate_stream(0, slot)
+    # The partially-imported page set was freed on the destination.
+    assert eng_b.allocator.num_in_use == 0
+    assert telemetry.counter("fleet.migration_fallbacks").value == before + 1
+    rest = list(g)  # cold replay, token-identical
+    assert first + rest == solo(family, prompt_of(6), 6, 10)
+    assert settled(eng_a) and settled(eng_b)
+
+
+# ---------------------------------------------------------------------------
+# Deadline accounting across migration
+
+
+def test_deadline_travels_with_the_stream(family):
+    """The ABSOLUTE deadline migrates with the request: remaining time
+    shrinks by migration wall-clock exactly as across failover hops."""
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    eng_b.detector.observe_tick(0.5)
+    h = router.submit(prompt_of(6), max_new_tokens=8, key=1,
+                      deadline_s=60.0)
+    g = h.tokens()
+    next(g)
+    (slot,) = eng_a.migratable_slots()
+    req = eng_a._slot_req[slot]
+    deadline_before = req.deadline
+    assert router.migrate_stream(0, slot)
+    assert req.deadline == deadline_before  # same absolute instant
+    list(g)
+
+
+def test_deadline_expired_mid_migration_single_terminal(family):
+    """A stream whose deadline expires at migration time is NOT
+    exported (nothing to double-serve) and surfaces DeadlineExceeded
+    exactly once — the idempotent _fail keeps the first terminal."""
+    eng_a, eng_b = make_engine(family), make_engine(family)
+    router = FleetRouter([eng_a, eng_b], version="v1")
+    eng_b.detector.observe_tick(0.5)
+    h = router.submit(prompt_of(6), max_new_tokens=32, key=8,
+                      deadline_s=60.0)
+    g = h.tokens()
+    next(g)
+    (slot,) = eng_a.migratable_slots()
+    req = eng_a._slot_req[slot]
+    req.deadline = time.perf_counter() - 0.001  # expires "mid-migration"
+    assert not router.migrate_stream(0, slot)
+    assert eng_a.stats()["migrations_out"] == 0
+    with pytest.raises(DeadlineExceeded):
+        list(g)
+    first_err = h.error
+    assert isinstance(first_err, DeadlineExceeded)
+    # A late second terminal (e.g. a racing migration fallback) must not
+    # replace the first: _fail is idempotent.
+    h._inner._fail(RequestPreempted("late loser"))
+    assert h._inner.error is not None
+    assert h.error is first_err
+    assert settled(eng_a) and settled(eng_b)
+
+
+# ---------------------------------------------------------------------------
+# Prefill/decode disaggregation
+
+
+def test_role_steering_and_rebalance(family):
+    """Long prompts route to the prefill-role replica; router.step()'s
+    rebalance ships the decode phase to the decode-role peer mid-stream
+    — token-identically.  Short prompts never land on prefill."""
+    eng_p = make_engine(family, role="prefill")
+    eng_d = make_engine(family, role="decode")
+    router = FleetRouter(version="v1", long_prompt_tokens=16)
+    router.add_replica(eng_p, version="v1")
+    router.add_replica(eng_d, version="v1")
+    # Short prompt: steered OFF the prefill replica regardless of load.
+    hs = router.submit(prompt_of(4), max_new_tokens=2, key=0)
+    assert hs.replica_id == 1
+    assert len(hs.result()) == 2
+    # Long prompt: lands on prefill...
+    before = telemetry.counter("fleet.migrations").value
+    h = router.submit(prompt_of(24), max_new_tokens=8, key=5)
+    assert h.replica_id == 0
+    g = h.tokens()
+    first = [next(g)]
+    # ...and the control loop hands its decode phase to the decode peer.
+    assert router.rebalance() == 1
+    assert telemetry.counter("fleet.migrations").value == before + 1
+    rest = list(g)
+    assert first + rest == solo(family, prompt_of(24), 5, 8)
+    assert eng_p.stats()["migrations_out"] == 1
+    assert eng_d.stats()["migrations_in"] == 1
+    assert eng_d.stats()["recoveries"] == 0
+    assert settled(eng_p) and settled(eng_d)
+    stats = router.stats()
+    assert [r["role"] for r in stats["replicas"]] == ["prefill", "decode"]
+
+
+def test_role_validation_and_gauge(family):
+    with pytest.raises(ValueError):
+        make_engine(family, role="turbo")
+    eng = make_engine(family, role="decode")
+    eid = eng.engine_id
+    assert telemetry.gauges().get(f"serve.role{{engine={eid}}}") == "decode"
+    eng.close()
+    assert f"serve.role{{engine={eid}}}" not in telemetry.gauges()

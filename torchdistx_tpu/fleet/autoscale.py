@@ -38,7 +38,18 @@ iterations, not engine ticks):
   ``min_replicas``.  The gap between the high and low water marks is the
   **hysteresis band**: a signal oscillating inside it resets both
   sustain counters and produces no decision at all, so the fleet never
-  flaps.
+  flaps.  The victim **drains by migration** first
+  (:meth:`~torchdistx_tpu.fleet.router.FleetRouter.migrate_out_streams`):
+  its in-flight streams warm-migrate to same-version peers with zero
+  recomputed prefill tokens, and only what could not move rides the
+  normal drain out (docs/fleet.md, "Disaggregation & stream
+  migration").
+
+* **Role-aware placement**: in a fleet running prefill/decode
+  disaggregation (engines with ``role=`` set), every spawn picks the
+  scarcer role — a replacement keeps its predecessor's role — passed to
+  the factory as ``make_engine(role=...)`` when it accepts the keyword
+  (a role-less factory is called as before).
 
 * **Replace, don't count**: a replica whose engine latched the
   divergence flag (:ref:`audit plane <docs/observability.md>`) is
@@ -338,7 +349,9 @@ class Autoscaler:
             rep.engine.begin_drain()
             self.replaces += 1
             if len(capacity) + 1 <= cfg.max_replicas:
-                self._spawn()
+                # The replacement inherits the drained replica's role so
+                # a disaggregated fleet keeps its prefill/decode shape.
+                self._spawn(role=getattr(rep.engine, "role", None))
                 capacity.append(self.router.replicas()[-1])
             self._last_out = self._tick_no
             decision = self._decide("replace_diverging", len(capacity))
@@ -384,7 +397,7 @@ class Autoscaler:
         )
         if n < cfg.min_replicas:
             while n < cfg.min_replicas:
-                self._spawn()
+                self._spawn(role=self._desired_role())
                 n += 1
             self._last_out = self._tick_no
             self._hi_ticks = self._lo_ticks = 0
@@ -395,7 +408,7 @@ class Autoscaler:
             and self._cooled(self._last_out, cfg.scale_out_cooldown)
         ):
             reason = high if high is not None else "queue_slope"
-            self._spawn()
+            self._spawn(role=self._desired_role())
             self.scale_outs += 1
             _T_SCALE_OUTS.add()
             self._last_out = self._tick_no
@@ -412,6 +425,10 @@ class Autoscaler:
         ):
             victim = max(capacity, key=lambda r: (-r.load(), r.rid))
             self.router.close_admission(victim.rid)
+            # Drain by migration: ship the victim's in-flight streams to
+            # surviving same-version peers (zero recomputed tokens);
+            # whatever could not move finishes under the normal drain.
+            self.router.migrate_out_streams(victim.rid)
             victim.engine.begin_drain()
             self.scale_ins += 1
             _T_SCALE_INS.add()
@@ -438,8 +455,32 @@ class Autoscaler:
     def _cooled(self, last: Optional[int], cooldown: int) -> bool:
         return last is None or self._tick_no - last >= cooldown
 
-    def _spawn(self) -> int:
-        eng = self.make_engine()
+    def _desired_role(self) -> Optional[str]:
+        """Role for the next spawn in a disaggregated fleet: the
+        scarcer of prefill/decode among non-draining replicas (ties go
+        to decode — decode capacity bounds steady-state throughput).
+        None (factory default) in a role-less fleet."""
+        roles = [
+            getattr(rep.engine, "role", "mixed")
+            for rep in self.router.replicas()
+            if rep.engine.health() is not Health.DRAINING
+        ]
+        if not any(r != "mixed" for r in roles):
+            return None
+        n_prefill = sum(r == "prefill" for r in roles)
+        n_decode = sum(r == "decode" for r in roles)
+        return "prefill" if n_prefill < n_decode else "decode"
+
+    def _spawn(self, role: Optional[str] = None) -> int:
+        if role is not None:
+            try:
+                eng = self.make_engine(role=role)
+            except TypeError:
+                # Factory predates roles (or hard-pins its own): spawn
+                # role-less rather than refusing to scale.
+                eng = self.make_engine()
+        else:
+            eng = self.make_engine()
         return self.router.add_replica(eng, version=self.version)
 
     def _decide(self, reason: str, n: int) -> str:

@@ -16,14 +16,22 @@ standby engine.  The swap choreography:
 3. **Shift admission**: the router's gate closes on every v replica
    (:meth:`~.router.FleetRouter.close_admission`) — new work now routes
    only to v+1.  This happens between chunks; no stream is interrupted.
-4. **Drain** v gracefully (:meth:`~torchdistx_tpu.serving.engine.Engine
+4. **Migrate** what can move: in-flight v streams warm-migrate to any
+   REMAINING same-version peer (:meth:`~.router.FleetRouter
+   .migrate_out_streams` — KV pages ship at the page level, zero
+   recompute; docs/fleet.md, "Disaggregation & stream migration").
+   Migration is version-pinned, so when the swap retires the LAST v
+   replica there is no compatible destination and every stream is
+   simply left in place — skipped, not failed — for step 5.
+5. **Drain** v gracefully (:meth:`~torchdistx_tpu.serving.engine.Engine
    .begin_drain` — PR 5's SIGTERM path, minus the signal): queued work
    flushes with retryable typed errors (the router re-routes it to v+1
    on its next pull — those requests have yielded nothing, so the
-   version change is invisible), while **in-flight streams finish on
-   their original engine** under the drain deadline.  Tokens from two
-   versions never interleave within one stream.
-5. **Retire**: each drained v engine is removed and ``close()``-d
+   version change is invisible), while in-flight streams that could
+   not migrate **finish on their original engine** under the drain
+   deadline.  Tokens from two versions never interleave within one
+   stream.
+6. **Retire**: each drained v engine is removed and ``close()``-d
    (idempotent on a STOPPED engine), its pages all returned.
 
 A v stream that outlives the drain deadline fails with a *retryable*
@@ -110,6 +118,13 @@ def hot_swap(
         # v's waiting requests (which have yielded nothing) to v+1.
         for rep in old:
             router.close_admission(rep.rid)
+        # Warm-migrate in-flight v streams to surviving same-version
+        # peers (a partial retire) before draining.  Version-pinned: a
+        # full upgrade has no v peer left, migrate_out_streams skips
+        # every stream, and the drain below finishes them in place.
+        n_migrated = 0
+        for rep in old:
+            n_migrated += router.migrate_out_streams(rep.rid)["migrated"]
         for rep in old:
             rep.engine.begin_drain()
         steps = 0
@@ -128,7 +143,10 @@ def hot_swap(
         for rep in old:
             router.remove_replica(rep.rid)  # close() idempotent on STOPPED
         _T_SWAPS.add()
-        sp.end(n_retired=len(old), new_replica=new_rid, steps=steps)
+        sp.end(
+            n_retired=len(old), new_replica=new_rid, steps=steps,
+            n_migrated=n_migrated,
+        )
         return new_rid
     except BaseException:
         sp.cancel()

@@ -51,10 +51,31 @@ close/drain choreography, so each affected fleet handle re-routes itself
 on its next pull.  A replacement can be respawned into the fleet with
 :meth:`FleetRouter.add_replica` at any time.
 
+**Stream migration & role disaggregation** (docs/fleet.md,
+"Disaggregation & stream migration"): a live decoding stream can move
+between same-version replicas WITHOUT recompute —
+:meth:`FleetRouter.migrate_stream` drives the engine pair's
+``migrate_out()``/``migrate_in()`` (pages gather to host, digest-verify
+on arrival, scatter into the peer's pool; the same ``fold_in(key,
+n_gen)`` schedule continues token-identically).  Graceful drains
+(:meth:`FleetRouter.migrate_out_streams` — hot swap and autoscaler
+scale-in call it) prefer migration over waiting streams out; a failed
+import falls back to the cold key-pinned replay this module already
+owns, counted on ``fleet.migration_fallbacks`` — cold replay also
+remains the ONLY path when the source pool is gone (crash), since there
+is nothing left to export.  Engines advertise a ``role``
+(prefill/decode/mixed): routing steers long prompts to prefill-role
+replicas, keeps short/chatty work off them, and
+:meth:`FleetRouter.rebalance` (run by every :meth:`FleetRouter.step`)
+ships decode-phase streams from prefill-role replicas to decode-role
+peers mid-stream — the DistServe/vLLM-lineage prefill/decode split.
+
 Telemetry: ``fleet.submitted`` / ``fleet.failovers`` /
-``fleet.hops_exhausted`` counters and the ``fleet.replicas_ready`` gauge
-(docs/observability.md); the hot-swap machinery adds ``fleet.swaps`` and
-the ``fleet.swap`` span (:mod:`.hot_swap`).
+``fleet.hops_exhausted`` / ``fleet.migrations`` /
+``fleet.migration_fallbacks`` counters, the ``fleet.replicas_ready``
+gauge, and the ``fleet.failover_added_s`` / ``fleet.migration_s``
+histograms (docs/observability.md); the hot-swap machinery adds
+``fleet.swaps`` and the ``fleet.swap`` span (:mod:`.hot_swap`).
 """
 
 from __future__ import annotations
@@ -72,9 +93,11 @@ from ..telemetry import audit as _audit
 from ..telemetry import ops as _ops
 from ..serving.lifecycle import (
     DeadlineExceeded,
+    DeterminismDiverged,
     Health,
     RequestCancelled,
     RequestError,
+    RequestPreempted,
 )
 
 __all__ = [
@@ -94,6 +117,19 @@ _G_REPLICAS_READY = _telemetry.gauge("fleet.replicas_ready")
 # typed failure to the successful re-submission on a peer (backoff
 # sleeps included — they are part of what the consumer waits).
 _H_FAILOVER_ADDED = _telemetry.histogram("fleet.failover_added_s")
+# Warm stream migrations: completed page-level moves vs. imports that
+# failed and fell back to the cold key-pinned replay.  The histogram is
+# the full export→import wall clock — what a migrated stream's consumer
+# waited, the number the bench compares against cold-replay added
+# latency.
+_T_MIGRATIONS = _telemetry.counter("fleet.migrations")
+_T_MIGRATION_FALLBACKS = _telemetry.counter("fleet.migration_fallbacks")
+_H_MIGRATION = _telemetry.histogram("fleet.migration_s")
+
+# Migration destination preference by engine role: decode-role replicas
+# exist to absorb mid-stream work, mixed take anything, prefill-role
+# replicas are what migration is shipping work AWAY from (last resort).
+_ROLE_DEST_ORDER = {"decode": 0, "mixed": 1, "prefill": 2}
 
 # Fleet-wide trace-id mint ("fleet-r0", "fleet-r1", ...): ONE id pinned
 # at fleet submission and forwarded on every failover hop, so every
@@ -300,7 +336,10 @@ class FleetHandle:
                 # long placement wait — fail it as its own typed error,
                 # not a generic NoReplicaAvailable at budget exhaustion.
                 self._remaining_deadline_s()
-            rep = self._router._pick(exclude=excluded, version=version)
+            rep = self._router._pick(
+                exclude=excluded, version=version,
+                prompt_len=len(self._prompt),
+            )
             if rep is None and excluded:
                 # Every candidate was excluded by a failed attempt in
                 # THIS binding.  Exclusion only means "not again without
@@ -310,7 +349,10 @@ class FleetHandle:
                 # stop shunning the pool and try it again rather than
                 # failing a single-replica fleet on its first hiccup.
                 excluded = set()
-                rep = self._router._pick(exclude=excluded, version=version)
+                rep = self._router._pick(
+                    exclude=excluded, version=version,
+                    prompt_len=len(self._prompt),
+                )
             if rep is None:
                 if self.hops < self._max_hops:
                     # A fleet with NO routable replica is routinely a
@@ -545,6 +587,12 @@ class FleetRouter:
         ``is_retryable`` classifies failures (honoring the
         ``RequestError.retryable`` contract) and whose ``delay``
         schedule paces the hops.  Default: 5 ms base, 250 ms cap.
+    long_prompt_tokens : prompt length (tokens) at which routing
+        prefers a ``role="prefill"`` replica; shorter prompts prefer
+        decode/mixed-role replicas.  Role preference is advisory — a
+        role-less fleet routes exactly as before, and a role never
+        makes a request unroutable (the non-preferred pool is the
+        fallback).  Default 2048.
     ops_port : opt the whole fleet into the live ops plane
         (:mod:`torchdistx_tpu.telemetry.ops`): the router get-or-creates
         the plane on the port and ``retain()``-s it so it outlives
@@ -570,12 +618,16 @@ class FleetRouter:
         version: str = "v0",
         max_hops: int = 3,
         retry: Optional[RetryPolicy] = None,
+        long_prompt_tokens: int = 2048,
         ops_port: Optional[int] = None,
         ops_config: Optional[_ops.OpsConfig] = None,
     ):
         if max_hops < 0:
             raise ValueError("max_hops must be >= 0")
+        if long_prompt_tokens < 1:
+            raise ValueError("long_prompt_tokens must be >= 1")
         self.max_hops = max_hops
+        self.long_prompt_tokens = int(long_prompt_tokens)
         self.retry = retry or RetryPolicy(
             max_attempts=max_hops + 1, base_delay_s=0.005, max_delay_s=0.25
         )
@@ -695,10 +747,13 @@ class FleetRouter:
         self,
         exclude=frozenset(),
         version: Optional[str] = None,
+        prompt_len: Optional[int] = None,
     ) -> Optional[Replica]:
         """Least-estimated-TTFT among routable replicas.  READY (and
         STARTING) replicas are preferred; OVERLOADED ones serve only as
-        a last resort; DRAINING/STOPPED never route."""
+        a last resort; DRAINING/STOPPED never route.  With
+        ``prompt_len``, role steering applies within the health-
+        preferred pool (see :meth:`_role_pool`)."""
         candidates = [
             rep
             for rep in self._replicas.values()
@@ -713,10 +768,38 @@ class FleetRouter:
         preferred = [
             rep for rep in candidates if rep.engine.health() in _PREFERRED
         ]
-        pool = preferred or candidates
+        pool = self._role_pool(preferred or candidates, prompt_len)
         return min(
             pool, key=lambda r: (r.engine.est_ttft_s(), r.load(), r.rid)
         )
+
+    def _role_pool(
+        self, pool: List[Replica], prompt_len: Optional[int]
+    ) -> List[Replica]:
+        """Prefill/decode disaggregation steering (docs/fleet.md): long
+        prompts (``>= long_prompt_tokens``) prefer prefill-role
+        replicas — their pages ship to a decode-role peer mid-stream
+        via :meth:`rebalance` — while short/chatty work stays OFF
+        prefill-role replicas so a 16k-token prefill never sits in
+        front of its decode chunks.  Advisory only: a role-less pool
+        passes through untouched, and when no replica of the preferred
+        role is routable the whole pool is the fallback."""
+        if prompt_len is None:
+            return pool
+        roles = {getattr(r.engine, "role", "mixed") for r in pool}
+        if roles <= {"mixed"}:
+            return pool
+        if prompt_len >= self.long_prompt_tokens:
+            pref = [
+                r for r in pool
+                if getattr(r.engine, "role", "mixed") == "prefill"
+            ]
+        else:
+            pref = [
+                r for r in pool
+                if getattr(r.engine, "role", "mixed") != "prefill"
+            ]
+        return pref or pool
 
     def _update_ready_gauge(self) -> None:
         _G_REPLICAS_READY.set(
@@ -725,6 +808,175 @@ class FleetRouter:
                 for rep in self._replicas.values()
             )
         )
+
+    # ------------------------------------------------------------------
+    # Stream migration (docs/fleet.md, "Disaggregation & stream
+    # migration"): move live decoding streams between replicas at the
+    # KV-page level — zero recompute, digest-verified on arrival.
+
+    def _migration_dests(self, src_rid: int, version: str) -> List[Replica]:
+        """Candidate import targets for a stream leaving ``src_rid``:
+        same weights version (a migrated stream must never interleave
+        two models — the same pin as mid-stream failover), routable,
+        still admitting, ordered decode-role first, then mixed, then
+        (last resort) prefill, with least-loaded tiebreak."""
+        candidates = [
+            rep
+            for rep in self._replicas.values()
+            if rep.rid != src_rid
+            and rep.admitting
+            and rep.version == version
+            and rep.engine.health() in _ROUTABLE
+        ]
+        return sorted(
+            candidates,
+            key=lambda r: (
+                _ROLE_DEST_ORDER.get(getattr(r.engine, "role", "mixed"), 1),
+                0 if r.engine.health() in _PREFERRED else 1,
+                r.engine.est_ttft_s(),
+                r.load(),
+                r.rid,
+            ),
+        )
+
+    def migrate_stream(self, rid: int, slot: int) -> bool:
+        """Warm-migrate ONE live stream off replica ``rid``'s engine
+        slot to the best same-version peer.  Returns True when the
+        stream continues on the peer (the consumer's handle keeps
+        streaming, token-identically, with zero recomputed tokens).
+
+        Returns False and leaves the stream UNTOUCHED on the source
+        when there is no compatible destination, the stream's deadline
+        already expired (the source engine's own reap surfaces
+        ``DeadlineExceeded`` — exactly once), or the export itself
+        declines (injected fault, pool lost): a failed export must
+        never strand a running stream.  When the export succeeded but
+        every candidate refuses the import (geometry/version mismatch,
+        overload, injected import fault), the source slot is already
+        gone — the engine-side handle is failed with a retryable
+        ``RequestPreempted`` so the :class:`FleetHandle` falls back to
+        the cold key-pinned replay on its next pull, counted on
+        ``fleet.migration_fallbacks``.  A ``DeterminismDiverged`` on
+        arrival is terminal (the engine already failed the handle
+        typed): a corrupt stream is never replayed.
+
+        The fleet handle's ``replica_id`` is a routing hint, not a
+        liveness contract — it goes stale across a migration and is
+        refreshed by the next (re-)bind, which excludes it anyway."""
+        rep = self._replicas.get(rid)
+        if rep is None:
+            return False
+        eng = rep.engine
+        req = eng._slot_req[slot] if slot < len(eng._slot_req) else None
+        if req is None:
+            return False
+        if req.deadline is not None and time.perf_counter() >= req.deadline:
+            return False
+        dests = self._migration_dests(rid, rep.version)
+        if not dests:
+            return False
+        t0 = time.perf_counter()
+        try:
+            snapshot = eng.migrate_out(slot)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:  # noqa: BLE001 — export declined; stream untouched
+            return False
+        last_err: Optional[BaseException] = None
+        for dest in dests:
+            try:
+                dest.engine.migrate_in(snapshot)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except DeterminismDiverged:
+                # migrate_in already failed the handle typed and
+                # flight-dumped; there is nothing to fall back to.
+                return False
+            except Exception as err:  # noqa: BLE001 — try the next candidate
+                last_err = err
+                continue
+            _T_MIGRATIONS.add()
+            _H_MIGRATION.observe(time.perf_counter() - t0)
+            return True
+        # Export succeeded but no candidate would take the import: the
+        # page snapshot is dropped and the stream falls back to the
+        # cold replay path — the FleetHandle catches the retryable
+        # preemption on its next pull and replays from the pinned key.
+        _T_MIGRATION_FALLBACKS.add()
+        if req.trace_id is not None:
+            _telemetry.event(
+                "req.migration_fallback",
+                rid=req.trace_id,
+                engine=getattr(eng, "engine_id", None),
+                error=type(last_err).__name__ if last_err else None,
+                n_tokens=int(snapshot.get("n_tokens", 0)),
+            )
+        req.handle._fail(
+            RequestPreempted(
+                "stream migration failed mid-import ("
+                + (
+                    f"{type(last_err).__name__}: {last_err}"
+                    if last_err is not None
+                    else "no importable destination"
+                )
+                + "); falling back to a key-pinned replay",
+                resumable=False,
+            )
+        )
+        return False
+
+    def migrate_out_streams(self, rid: int) -> Dict[str, int]:
+        """Drain-by-migration: warm-migrate every migratable stream off
+        replica ``rid`` (graceful drains — hot swap and autoscaler
+        scale-in — call this BEFORE ``begin_drain()``, so in-flight
+        streams finish on peers with zero recomputed prefill tokens
+        instead of holding the drain open).  Streams with no compatible
+        destination are left running for the normal drain to finish —
+        skipping is strictly better than failing them.  Returns
+        ``{"migrated", "fallbacks", "left"}`` counts."""
+        out = {"migrated": 0, "fallbacks": 0, "left": 0}
+        rep = self._replicas.get(rid)
+        if rep is None:
+            return out
+        slots = getattr(rep.engine, "migratable_slots", None)
+        if slots is None:
+            # An engine without the migration API (a stub, an older
+            # build) drains the normal way — nothing to move warm.
+            return out
+        before = _T_MIGRATION_FALLBACKS.value
+        for slot in list(slots()):
+            if self.migrate_stream(rid, slot):
+                out["migrated"] += 1
+        out["fallbacks"] = _T_MIGRATION_FALLBACKS.value - before
+        out["left"] = rep.engine._n_running()
+        return out
+
+    def rebalance(self) -> int:
+        """The prefill→decode handoff: ship decode-phase streams OFF
+        prefill-role replicas onto decode/mixed-role same-version peers
+        mid-stream.  Run by every :meth:`step`; a no-op in a role-less
+        fleet.  Returns the number of streams moved.
+
+        Capacity-gated: the handoff is an *optimization*, and an export
+        whose import is then refused can only fall back to a cold
+        replay — so a stream is shipped only while some candidate has a
+        free slot to land it.  A saturated decode tier just means the
+        prefill replica keeps decoding the stream itself."""
+        moved = 0
+        for rep in self.replicas():
+            if getattr(rep.engine, "role", "mixed") != "prefill":
+                continue
+            if rep.engine.health() not in _ROUTABLE:
+                continue
+            for slot in list(rep.engine.migratable_slots()):
+                if not any(
+                    d.engine._n_running() < d.engine.num_slots
+                    for d in self._migration_dests(rep.rid, rep.version)
+                ):
+                    break
+                if self.migrate_stream(rep.rid, slot):
+                    moved += 1
+        return moved
 
     # ------------------------------------------------------------------
     # The fleet API
@@ -806,6 +1058,7 @@ class FleetRouter:
         for rep in self.replicas():
             if rep.engine.health() is not Health.STOPPED:
                 rep.engine.step()
+        self.rebalance()
         self.poll()
 
     def stats(self) -> dict:
@@ -818,6 +1071,7 @@ class FleetRouter:
                     "version": rep.version,
                     "admitting": rep.admitting,
                     "health": rep.engine.health().value,
+                    "role": getattr(rep.engine, "role", "mixed"),
                     "est_ttft_s": round(rep.engine.est_ttft_s(), 4),
                     "load": rep.load(),
                 }
@@ -826,4 +1080,6 @@ class FleetRouter:
             "submitted": _T_SUBMITTED.value,
             "failovers": _T_FAILOVERS.value,
             "hops_exhausted": _T_HOPS_EXHAUSTED.value,
+            "migrations": _T_MIGRATIONS.value,
+            "migration_fallbacks": _T_MIGRATION_FALLBACKS.value,
         }

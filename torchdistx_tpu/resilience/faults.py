@@ -35,6 +35,15 @@ Sites (where the stack asks):
   preemption path (step = swap attempt).  ``io``/``nan`` fail the swap
   — the gather is read-only, so device state is untouched and the
   preemption falls back to drop-and-replay, still token-identical.
+* ``serve.migrate_out`` — before one cross-engine stream-migration
+  export (step = export attempt).  ``io``/``nan`` fail the export
+  BEFORE the page gather: the source stream keeps running untouched —
+  a failed export must never strand or double-serve a live stream.
+* ``serve.migrate_in`` — mid-import of a migrated stream, after the
+  destination allocated its pages but before the scatter (step = import
+  attempt).  ``io``/``nan`` fail the import: the partial page set is
+  freed on the destination (no leak) and the stream falls back to a
+  cold key-pinned replay — no double-serve, token-identical either way.
 
 Kinds (what happens):
 
@@ -102,6 +111,8 @@ SITES = frozenset(
         "serve.step",
         "serve.recover",
         "serve.swap",
+        "serve.migrate_out",
+        "serve.migrate_in",
     }
 )
 KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan", "corrupt"})

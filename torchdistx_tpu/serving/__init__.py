@@ -62,6 +62,7 @@ from .cache import (  # noqa: F401
     copy_pages,
     fresh_pool,
     init_paged_cache,
+    pool_geometry,
     swap_in_pages,
     swap_out_pages,
     write_prompt,
@@ -74,6 +75,7 @@ from .lifecycle import (  # noqa: F401
     EngineDraining,
     EngineOverloaded,
     Health,
+    MigrationIncompatible,
     OverloadDetector,
     RecoveryFailed,
     RequestCancelled,
@@ -92,6 +94,7 @@ __all__ = [
     "EngineOverloaded",
     "FIFOScheduler",
     "Health",
+    "MigrationIncompatible",
     "OverloadDetector",
     "PrefixIndex",
     "QoSScheduler",
@@ -106,6 +109,7 @@ __all__ = [
     "fresh_pool",
     "init_paged_cache",
     "page_hashes",
+    "pool_geometry",
     "swap_in_pages",
     "swap_out_pages",
     "write_prompt",

@@ -42,11 +42,13 @@ import numpy as np
 
 from ..telemetry import perf as _perf
 from .blocks import TRASH_BLOCK
+from .lifecycle import MigrationIncompatible
 
 __all__ = [
     "copy_pages",
     "fresh_pool",
     "init_paged_cache",
+    "pool_geometry",
     "swap_in_pages",
     "swap_out_pages",
     "write_prompt",
@@ -136,6 +138,24 @@ def write_prompt(paged, contiguous, table, length, *, block_size: int):
     return jax.tree.map(scatter, paged, contiguous)
 
 
+def pool_geometry(paged) -> tuple:
+    """Hashable per-leaf page geometry of a pool (or of a
+    :func:`swap_out_pages` host buffer): the pytree structure plus each
+    leaf's ``(L, block_size, Hkv, Dh, dtype)`` — everything about a page
+    EXCEPT how many the pool holds.  Two pools with equal geometry can
+    exchange page snapshots bit-for-bit; anything else cannot, whatever
+    the byte counts happen to be.  The cross-engine migration path
+    compares these before any scatter (see :func:`swap_in_pages`)."""
+    leaves, treedef = jax.tree.flatten(paged)
+    return (
+        str(treedef),
+        tuple(
+            (x.shape[0],) + tuple(x.shape[2:]) + (str(x.dtype),)
+            for x in leaves
+        ),
+    )
+
+
 def _page_bucket(n: int) -> int:
     """Swap-transfer pad width: next power of two — one gather and one
     scatter compile per bucket, not per page count."""
@@ -175,8 +195,28 @@ def swap_in_pages(paged, host, pages):
     """Scatter a :func:`swap_out_pages` buffer back into freshly
     allocated ``pages`` (the pool is donated — in place on device).
     ``len(pages)`` must equal the buffer's page count; pad rows (zeros)
-    land in the trash page."""
+    land in the trash page.
+
+    The buffer's page geometry is validated against the pool BEFORE the
+    scatter.  Same-pool swap round trips match trivially; a CROSS-pool
+    import (stream migration) with a different layer count, page size,
+    head shape, or dtype raises a typed, retryable
+    :class:`.lifecycle.MigrationIncompatible` — never a silent
+    broadcast/cast into the destination pool (and never a shape error
+    surfacing from inside a donated call that already consumed it)."""
+    if pool_geometry(paged) != pool_geometry(host):
+        raise MigrationIncompatible(
+            "page snapshot does not fit this pool: snapshot geometry "
+            f"{pool_geometry(host)!r} != pool geometry "
+            f"{pool_geometry(paged)!r}; fall back to a key-pinned replay"
+        )
     n = len(pages)
+    n_rows = jax.tree.leaves(host)[0].shape[1]
+    if n != n_rows:
+        raise MigrationIncompatible(
+            f"page snapshot holds {n_rows} page(s) but {n} destination "
+            "page(s) were allocated"
+        )
     bucket = _page_bucket(n)
     idx = np.full((bucket,), TRASH_BLOCK, np.int32)
     idx[:n] = pages

@@ -109,7 +109,12 @@ the serving analog of the training loop's skip-step non-finite guard);
 ``serve.recover`` — fails one supervisor replay attempt, consuming
 recovery budget; ``serve.swap`` — fails one swap-to-host gather (read-
 only, device state untouched) and the preemption falls back to
-drop-and-replay.  ``fatal`` propagates everywhere: fatal means fatal.
+drop-and-replay; ``serve.migrate_out`` — fails one stream-migration
+export before its page gather (the source stream keeps running,
+untouched); ``serve.migrate_in`` — fails one migration import after the
+destination allocated pages but before the scatter (the partial page set
+frees, the stream falls back to cold replay).  ``fatal`` propagates
+everywhere: fatal means fatal.
 """
 
 from __future__ import annotations
@@ -138,6 +143,7 @@ from .cache import (
     copy_pages,
     fresh_pool,
     init_paged_cache,
+    pool_geometry,
     swap_in_pages,
     swap_out_pages,
 )
@@ -147,6 +153,7 @@ from .lifecycle import (
     EngineDraining,
     EngineOverloaded,
     Health,
+    MigrationIncompatible,
     OverloadDetector,
     RecoveryFailed,
     RequestCancelled,
@@ -179,6 +186,9 @@ _T_COW = _telemetry.counter("serve.cow_copies")
 _T_PREFIX_EVICTIONS = _telemetry.counter("serve.prefix_evictions")
 _T_IDLE_TICKS = _telemetry.counter("serve.idle_ticks")
 _T_CORRUPTIONS = _telemetry.counter("serve.corruptions")
+_T_MIGRATIONS_OUT = _telemetry.counter("serve.migrations_out")
+_T_MIGRATIONS_IN = _telemetry.counter("serve.migrations_in")
+_T_MIGRATED_PAGES = _telemetry.counter("serve.migrated_pages")
 _G_RUNNING = _telemetry.gauge("serve.running_slots")
 _G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
 _G_TTFT = _telemetry.gauge("serve.ttft_s")
@@ -409,6 +419,18 @@ class Engine:
         training loop (or two engines) sharing a process would race for
         the notice.  Retire an engine without a drain via
         :meth:`close`, which restores the handlers it installed.
+    role : disaggregation role of this engine in a fleet —
+        ``"mixed"`` (default: serves anything, the solo-engine
+        behavior), ``"prefill"`` (the router steers long prompts here;
+        streams migrate OUT to a decode-role peer once their prefill
+        completes), or ``"decode"`` (protected from long prompts; the
+        natural :meth:`migrate_in` destination).  The role changes
+        nothing engine-side — admission, ticking, and recovery are
+        identical — it is a routing/migration hint the
+        :class:`~torchdistx_tpu.fleet.FleetRouter` and autoscaler read
+        (docs/fleet.md, "Disaggregation & stream migration").  Exported
+        as the ``serve.role{engine=...}`` labeled gauge, pruned at
+        STOPPED.
     model_version : weights-version tag folded into every request's
         determinism digest (docs/observability.md, "Audit plane").  Tag
         real weight versions distinctly (hot-swap standbys especially):
@@ -458,6 +480,7 @@ class Engine:
         engine_id: Optional[str] = None,
         ops_port: Optional[int] = None,
         ops_config: Optional[_ops.OpsConfig] = None,
+        role: str = "mixed",
         model_version: str = "v0",
         audit_sample: Optional[float] = None,
     ):
@@ -522,6 +545,11 @@ class Engine:
                 "scheduler has no priority classes to shed by)"
             )
         self.shed_policy = shed_policy
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role {role!r}: expected 'prefill', 'decode', or 'mixed'"
+            )
+        self.role = role
         self.max_recoveries = int(max_recoveries)
         if self.max_recoveries < 0:
             raise ValueError("max_recoveries must be >= 0")
@@ -601,6 +629,8 @@ class Engine:
         self._decode_no = 0  # decode chunks attempted (serve.step site)
         self._recover_no = 0  # supervisor replay attempts (serve.recover)
         self._swap_no = 0  # swap-out attempts (serve.swap fault site)
+        self._migrate_out_no = 0  # stream exports (serve.migrate_out site)
+        self._migrate_in_no = 0  # stream imports (serve.migrate_in site)
         self._preempted_this_tick = False  # swap-in back-off after a preempt
         self._decode_s = 0.0
         self._decode_tokens = 0
@@ -612,6 +642,8 @@ class Engine:
         self._n_preempted = 0
         self._n_preempt_swap = 0
         self._n_preempt_replay = 0
+        self._n_migrated_out = 0
+        self._n_migrated_in = 0
         self._n_cow = 0
 
         # Per-engine labeled metrics (docs/observability.md): N fleet
@@ -637,6 +669,12 @@ class Engine:
         self._h_outage = _telemetry.histogram(
             "serve.preempt_outage_s", engine=eid
         )
+        # Disaggregation role (docs/fleet.md): a labeled gauge so an
+        # operator (and the autoscaler's role-aware placement) can read
+        # the fleet's role split off /metrics.  Pruned at STOPPED like
+        # every per-engine dynamic-label family.
+        self._lg_role = _telemetry.gauge("serve.role", engine=eid)
+        self._lg_role.set(self.role)
 
         self._drain_t0: Optional[float] = None
         self._drain_sp = None
@@ -705,6 +743,7 @@ class Engine:
                 prefill_chunk=self.prefill_chunk,
                 max_prefills_per_tick=max_prefills_per_tick,
                 scheduler=scheduler,
+                role=self.role,
                 model_version=self.model_version,
             )
 
@@ -1418,6 +1457,9 @@ class Engine:
         # replica churn must not grow /metrics by one series per engine
         # ever seen.
         _telemetry.remove("serve.queue_depth", engine=self.engine_id)
+        # And for the disaggregation-role family: the role is a routing
+        # hint, and a stopped engine routes nothing.
+        _telemetry.remove("serve.role", engine=self.engine_id)
         # Time-plane teardown: the tick-phase histogram family and the
         # host-overhead gauge leave the registry with the engine — no
         # serve.tick_phase_s row survives a drain (bounded cardinality
@@ -1811,6 +1853,273 @@ class Engine:
                 "req.resumed", req, mechanism="swap",
                 n_tokens=len(req.handle._tokens),
             )
+
+    # ------------------------------------------------------------------
+    # Cross-engine stream migration (docs/fleet.md, "Disaggregation &
+    # stream migration")
+
+    def migratable_slots(self) -> list:
+        """Slots whose stream can :meth:`migrate_out` right now:
+        occupied, past prefill (committed tokens exist), resident on
+        device (not swapped to host), and not already terminal."""
+        return [
+            slot
+            for slot, req in enumerate(self._slot_req)
+            if req is not None
+            and slot not in self._prefill_q
+            and slot not in self._swapped
+            and req.handle._tokens
+            and not req.handle._done
+        ]
+
+    def migrate_out(self, slot: int) -> dict:
+        """Export one live decoding stream as a self-contained host
+        snapshot a peer's :meth:`migrate_in` maps into its own pool
+        mid-stream — the warm half of fleet failover/drain, and the
+        prefill→decode handoff of role disaggregation.
+
+        The page gather is read-only and EVERY page in the stream's
+        table transfers — private AND shared: the destination has no
+        prefix-index entry for our prompt, so shared/CoW prefix pages
+        resolve into the snapshot rather than into a dangling
+        cross-engine reference.  Only after the gather lands does the
+        source release: our page references drop (shared pages stay
+        with the prefix index at exactly the index-owned refcount), the
+        slot clears, and the handle stays LIVE — the stream continues
+        on the destination, nothing terminal is surfaced here.
+
+        Raises (``serve.migrate_out`` fault, pool already lost, gather
+        failure) strictly BEFORE any source mutation: a failed export
+        leaves the stream running untouched."""
+        req = self._slot_req[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} is idle; nothing to migrate")
+        if slot in self._prefill_q or slot in self._swapped:
+            raise ValueError(
+                f"slot {slot} is not decoding on-device (mid-prefill or "
+                "swapped out); migrate only resident decode streams"
+            )
+        toks = req.handle._tokens
+        if not toks or req.handle._done:
+            raise ValueError(
+                f"request {req.rid} has no live committed stream to migrate"
+            )
+        self._migrate_out_no += 1
+        kind = faults.fire("serve.migrate_out", self._migrate_out_no)
+        if kind is not None:
+            # Cooperation kinds (nan) poison this export attempt: same
+            # contract as io — the caller's stream keeps running here.
+            raise faults.InjectedFault(
+                f"poisoned migration export ({kind})"
+            )
+        sp = _telemetry.start_span(
+            "serve.migrate_out", slot=slot,
+            n_pages=len(req.blocks), n_tokens=len(toks),
+        )
+        try:
+            if self._pool_lost():
+                raise RuntimeError(
+                    "source pool is gone; this stream recovers by replay"
+                )
+            host = swap_out_pages(self._cache, req.blocks)
+        except BaseException:
+            # Read-only gather: device and slot state are untouched —
+            # the stream keeps running on THIS engine.
+            sp.cancel()
+            raise
+        n_pages = len(req.blocks)
+        snapshot = {
+            "req": req,
+            "host": host,
+            "n_pages": n_pages,
+            "geometry": pool_geometry(self._cache),
+            "block_size": self.block_size,
+            "model_version": self.model_version,
+            "src_engine": self.engine_id,
+            "digest": req.digest.hexdigest(),
+            "n_tokens": len(toks),
+        }
+        # Handoff point: everything below must not fail — from here the
+        # snapshot owns the stream's KV and the source owns nothing.
+        self.allocator.free(req.blocks)
+        req.blocks = None
+        req.table = None
+        req.preempt_t = time.perf_counter()  # outage clock: out → in
+        self._event(
+            "req.migrated_out", req, n_pages=n_pages, n_tokens=len(toks),
+        )
+        self._clear_slot(slot)
+        self._n_migrated_out += 1
+        _T_MIGRATIONS_OUT.add()
+        _T_MIGRATED_PAGES.add(n_pages)
+        sp.end(n_pages=n_pages, n_tokens=len(toks))
+        return snapshot
+
+    def migrate_in(self, snapshot: dict) -> RequestHandle:
+        """Map a :meth:`migrate_out` snapshot into this engine's pool and
+        resume the stream mid-flight — zero recompute: the pages scatter
+        in, the slot restores exactly where the source left it, and the
+        next decode step samples with ``fold_in(key, n_gen)``, the key
+        the uninterrupted run would have used.
+
+        Ordered so nothing can corrupt this pool or leak a page:
+
+        1. **compatibility** — weights version, page geometry
+           (``L``/``block_size``/``Hkv``/``Dh``/dtype), and table fit
+           are validated BEFORE anything allocates; a mismatch raises
+           typed, retryable :class:`.lifecycle.MigrationIncompatible`
+           (the stream falls back to a cold key-pinned replay);
+        2. **arrival digest** — the committed tokens re-hash against the
+           stream's determinism digest; a mismatch is a typed
+           :class:`.lifecycle.DeterminismDiverged` through the
+           divergence funnel (``audit.divergences`` + flight dump),
+           never a silent import;
+        3. **capacity** — a free slot and ``n_pages`` fresh pages (the
+           prefix-eviction reserve applies); shortage raises retryable
+           :class:`.lifecycle.EngineOverloaded`;
+        4. **import** — the ``serve.migrate_in`` fault site fires
+           between allocation and scatter: any failure here frees the
+           partial page set (or, if the donated scatter consumed the
+           pool, runs the recovery supervisor) and re-raises — the
+           caller cold-replays, no double-serve, no leak.
+
+        On success the request's handle is re-bound to THIS engine and
+        returned: an iterator already consuming it continues seamlessly.
+        """
+        req = snapshot["req"]
+        toks = list(req.handle._tokens)
+        if req.handle._done:
+            raise ValueError(
+                f"request {req.rid} is already terminal; nothing to import"
+            )
+        if self._health in (Health.DRAINING, Health.STOPPED):
+            raise EngineDraining(
+                f"engine is {self._health.value}; migrate to another replica"
+            )
+        if snapshot.get("model_version") != self.model_version:
+            raise MigrationIncompatible(
+                f"weights version mismatch: snapshot "
+                f"{snapshot.get('model_version')!r} != engine "
+                f"{self.model_version!r} — a cross-version migration "
+                "would interleave two models in one stream"
+            )
+        if snapshot.get("block_size") != self.block_size:
+            raise MigrationIncompatible(
+                f"page size mismatch: snapshot block_size="
+                f"{snapshot.get('block_size')} != engine block_size="
+                f"{self.block_size}"
+            )
+        if snapshot.get("geometry") != pool_geometry(self._cache):
+            raise MigrationIncompatible(
+                "pool geometry mismatch (layers / page size / heads / "
+                "head_dim / dtype); fall back to a key-pinned replay"
+            )
+        n_pages = int(snapshot["n_pages"])
+        if n_pages > self._table_width or (
+            req.cache_tokens > self.max_model_len
+        ):
+            raise MigrationIncompatible(
+                f"stream needs {n_pages} pages / {req.cache_tokens} "
+                f"positions but this engine's table holds "
+                f"{self._table_width} pages ({self.max_model_len} positions)"
+            )
+        if n_pages > self.allocator.capacity:
+            raise MigrationIncompatible(
+                f"stream needs {n_pages} pages but this engine owns "
+                f"{self.allocator.capacity}"
+            )
+        # Arrival verification (audit plane): the committed buffer must
+        # still hash to the stream's digest before its KV is mapped in.
+        if toks and not req.digest.matches_stream(
+            req.prompt, req.key, toks, self.model_version
+        ):
+            _audit.record_divergence(
+                self,
+                rid=req.trace_id,
+                where="migrate-in",
+                expected_digest=req.digest.hexdigest(),
+                replayed_digest=_audit.DeterminismDigest.of_stream(
+                    req.prompt, req.key, toks, self.model_version
+                ).hexdigest(),
+                n_tokens=len(toks),
+            )
+            err = DeterminismDiverged(
+                f"request {req.rid} arrived with a committed stream that "
+                f"no longer matches its determinism digest after "
+                f"{len(toks)} tokens"
+            )
+            req.handle._fail(err)
+            raise err
+        slot = next(
+            (i for i, r in enumerate(self._slot_req) if r is None), None
+        )
+        if slot is None:
+            raise EngineOverloaded(
+                "no free slot for the migrated stream; retry elsewhere"
+            )
+        pages = self._alloc_pages(n_pages)
+        if pages is None:
+            self._pool_exhausted("serve.migrate_in", n_pages)
+            raise EngineOverloaded(
+                f"could not reserve {n_pages} pages for the migrated "
+                "stream; retry elsewhere"
+            )
+        self._migrate_in_no += 1
+        sp = _telemetry.start_span(
+            "serve.migrate_in", slot=slot,
+            n_pages=n_pages, n_tokens=len(toks),
+            src=snapshot.get("src_engine"),
+        )
+        try:
+            kind = faults.fire("serve.migrate_in", self._migrate_in_no)
+            if kind is not None:
+                raise faults.InjectedFault(
+                    f"poisoned migration import ({kind})"
+                )
+            self._cache = swap_in_pages(self._cache, snapshot["host"], pages)
+        except (KeyboardInterrupt, SystemExit, faults.FatalInjectedFault):
+            sp.cancel()
+            self.allocator.free(pages)
+            raise
+        except Exception as err:
+            sp.cancel()
+            if self._pool_lost():
+                # The donated scatter consumed the pool: the supervisor
+                # rebuilds it and replays THIS engine's live streams;
+                # the arriving stream was never installed — its granted
+                # pages die with the allocator reset, and the caller's
+                # cold-replay fallback owns it.
+                self._oom_check(err, "serve.migrate_in")
+                self._supervise_recovery(err)
+            else:
+                self.allocator.free(pages)
+            raise
+        n_gen = len(toks)
+        table = np.zeros((self._table_width,), np.int32)
+        table[:n_pages] = pages
+        req.blocks = list(pages)
+        req.table = table
+        req.handle._engine = self
+        req.hop += 1  # a migration is a placement hop in the timeline
+        self._slot_req[slot] = req
+        self._tokens[slot] = toks[-1]
+        self._positions[slot] = len(req.prompt) + n_gen - 1
+        self._n_gen[slot] = n_gen
+        self._done[slot] = False
+        self._keys[slot] = req.key
+        self._tables[slot] = table
+        self._emitted[slot] = n_gen
+        if req.preempt_t is not None:
+            self._h_outage.observe(time.perf_counter() - req.preempt_t)
+            req.preempt_t = None
+        self._n_migrated_in += 1
+        _T_MIGRATIONS_IN.add()
+        self._event(
+            "req.migrated_in", req, n_pages=n_pages, n_tokens=n_gen,
+            src=snapshot.get("src_engine"),
+        )
+        sp.end(n_pages=n_pages, n_tokens=n_gen)
+        return req.handle
 
     # ------------------------------------------------------------------
     # Chunked prefill + the prefix cache
@@ -2591,6 +2900,9 @@ class Engine:
             "preemptions_swap": self._n_preempt_swap,
             "preemptions_replay": self._n_preempt_replay,
             "swapped_pages": self.allocator.num_swapped,
+            "role": self.role,
+            "migrations_out": self._n_migrated_out,
+            "migrations_in": self._n_migrated_in,
         }
         if self.prefix is not None:
             out["prefix_cached_pages"] = len(self.prefix)

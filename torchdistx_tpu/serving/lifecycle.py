@@ -37,6 +37,7 @@ __all__ = [
     "EngineDraining",
     "EngineOverloaded",
     "Health",
+    "MigrationIncompatible",
     "OverloadDetector",
     "RecoveryFailed",
     "RequestCancelled",
@@ -126,6 +127,19 @@ class RequestPreempted(RequestError):
 class RecoveryFailed(RequestError):
     """The crash-recovery supervisor exhausted the request's replay
     budget (``max_recoveries``) without completing it."""
+
+    retryable = True
+
+
+class MigrationIncompatible(RequestError):
+    """A live-stream KV page migration could not land on the destination
+    engine: pool geometry mismatch (layer count, page size, head shape,
+    dtype), a different weights version, or a snapshot wider than the
+    destination's block table.  The import is rejected BEFORE any page
+    scatter — an incompatible snapshot must never silently corrupt the
+    destination pool.  Retryable: the stream itself is fine, and a cold
+    key-pinned replay (the pre-migration failover path) reproduces it
+    token-identically on any replica."""
 
     retryable = True
 

@@ -197,7 +197,12 @@ class RequestHandle:
     def _fail(self, error: BaseException) -> None:
         """Abort the request with a typed error (see :mod:`.lifecycle`):
         consumers see the exception instead of a silently truncated
-        stream."""
+        stream.  Idempotent — the FIRST terminal error wins: a stream
+        whose deadline expires mid-migration is failed once by whichever
+        side observes it first (source fallback or destination reap),
+        never surfaced as two terminal events."""
+        if self._done:
+            return
         self.error = error
         self._done = True
         self._event(
