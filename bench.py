@@ -1490,6 +1490,134 @@ def bench_autoscale():
     return out
 
 
+def bench_models():
+    """Model-plane probe: many models on one engine's page pool.
+
+    One engine serves its own weights plus three deferred-init pool
+    models (same geometry, different seeds) with ``max_resident=2`` —
+    every cold demand past the budget thrashes the LRU weight eviction,
+    so the probe prices exactly what the model plane trades: a
+    materialize stall on first (or re-warmed) demand against near-zero
+    HBM for cold models.  Reported: cold TTFT per model (includes the
+    stall), warm TTFT p95 over a mixed four-model wave, the materialize
+    stall p95 from the pool's own clock, eviction count, the decode
+    recompile delta across models (must be 0 — same-geometry models
+    share the one compiled decode chunk), and the n=4 parallel-sampling
+    page amplification vs a solo request (prompt pages are shared via
+    the fork donor; only divergence CoW-copies).
+    """
+    import jax
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import Engine, ModelPool
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+
+    def seeded(seed):
+        def materialize():
+            return llama.init_params(jax.random.PRNGKey(seed), cfg)
+        return materialize
+
+    pool = ModelPool(max_resident=2)
+    for i, tag in enumerate(("m1", "m2", "m3"), start=1):
+        pool.register(
+            tag, model=llama, cfg=cfg, materialize=seeded(i),
+            model_version=f"{tag}@v1",
+        )
+    eng = Engine(
+        params, model=llama, cfg=cfg, num_slots=8, block_size=8,
+        num_blocks=81, max_model_len=64, decode_chunk=4,
+        handle_preemption=False, temperature=1.0, top_k=40,
+        model_pool=pool,
+    )
+    rng = np.random.default_rng(3)
+
+    def prompt(plen):
+        return rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+
+    def ttft(h):
+        t0 = time.perf_counter()
+        gen = h.tokens()
+        next(gen)
+        dt = time.perf_counter() - t0
+        for _ in gen:
+            pass
+        return dt
+
+    try:
+        # Cold pass: first demand per tag pays materialize + compile.
+        # The default model's weights are resident, so its cold TTFT is
+        # the compile-only baseline the stall reads against.
+        cold = {}
+        for tag in (None, "m1", "m2", "m3"):
+            cold[tag or "default"] = ttft(
+                eng.submit(prompt(8), max_new_tokens=4, key=0, model=tag)
+            )
+
+        c0 = {
+            k: v
+            for k, v in telemetry.snapshot()["counters"].items()
+            if "compile.count" in k and "decode" in k
+        }
+
+        # Warm wave: mixed four-model traffic.  m3 displaced one of
+        # m1/m2 during the cold pass, so round-robin demand here keeps
+        # re-warming evicted weights — warm p95 includes those stalls.
+        warm = []
+        tags = (None, "m1", "m2", "m3")
+        for i in range(24):
+            warm.append(ttft(eng.submit(
+                prompt(int(rng.integers(4, 16))),
+                max_new_tokens=int(rng.choice((4, 8))),
+                key=100 + i, model=tags[i % 4],
+            )))
+
+        c1 = {
+            k: v
+            for k, v in telemetry.snapshot()["counters"].items()
+            if "compile.count" in k and "decode" in k
+        }
+        decode_recompiles = sum(c1.values()) - sum(c0.values())
+
+        # Fork amplification: n=4 over a 4-page prompt vs one solo.
+        solo_h = eng.submit(prompt(32), max_new_tokens=8, key=7)
+        solo_peak = 0
+        while not solo_h.done:
+            eng.step()
+            solo_peak = max(solo_peak, eng.allocator.num_in_use)
+        fork_h = eng.submit(prompt(32), max_new_tokens=8, key=7, n=4)
+        fork_peak = 0
+        while not all(s.done for s in fork_h.siblings):
+            eng.step()
+            fork_peak = max(fork_peak, eng.allocator.num_in_use)
+        for s in fork_h.siblings:
+            s.result()
+
+        stats = pool.stats()
+        out = {
+            "n_models": 1 + stats["n_registered"],
+            "cold_ttft_s": {k: round(v, 4) for k, v in cold.items()},
+            "warm_ttft_p95_s": round(float(np.percentile(warm, 95)), 4),
+            "materialize_p95_s": stats["materialize_p95_s"],
+            "evictions": sum(
+                m["evictions"] for m in stats["models"].values()
+            ),
+            "decode_recompiles": decode_recompiles,  # must be 0
+            "fork_n4_peak_pages": fork_peak,
+            "solo_peak_pages": solo_peak,
+            "fork_page_amplification_vs_4x": round(
+                fork_peak / (4 * solo_peak), 3
+            ) if solo_peak else None,
+        }
+        eng.drain()
+        return out
+    finally:
+        eng.close()
+
+
 def bench_flash_attention(s=16384, b=1, h=8, d=128):
     """Long-context flash attention fwd+bwd at S=16k on one chip.
 
@@ -1620,6 +1748,10 @@ def main():
         migration = bench_migration()
     except Exception as e:  # noqa: BLE001
         migration = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        model_plane = bench_models()
+    except Exception as e:  # noqa: BLE001
+        model_plane = {"error": f"{type(e).__name__}: {e}"}
     # Second flash probe, minutes after the first (same compiled program,
     # deterministic work): tunnel windows last minutes, so two temporally
     # separated samples of the same measurement keep one bad window from
@@ -1667,6 +1799,7 @@ def main():
                     "fleet_failover": fleet,
                     "fleet_autoscale": autoscale,
                     "fleet_migration": migration,
+                    "model_plane": model_plane,
                     "cold_uncached_s": cold,
                     "peak_rss_mb": round(_rss_mb(), 1),
                     "device": str(jax.devices()[0]),

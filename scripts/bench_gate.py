@@ -94,6 +94,23 @@ METRICS: List[Tuple[str, Tuple[str, ...], bool, float]] = [
     ("migration_disagg_tpot_p95_ms",
      ("details", "fleet_migration", "disagg_chat_tpot_p95_ms"),
      False, 0.50),
+    # Model plane (ISSUE 18): the mixed four-model wave's warm TTFT
+    # p95 (includes re-warm stalls under eviction thrash), the
+    # materialize stall p95 from the pool's own clock, the decode
+    # recompile delta across models (zero tolerance — same-geometry
+    # models must share the one compiled chunk), and the n=4 fork page
+    # amplification vs 4x solo (must stay far below 1.0: prompt pages
+    # are donor-shared, only divergence CoW-copies).  All gate
+    # vacuously (no_baseline) until a round records them.
+    ("models_warm_ttft_p95_s",
+     ("details", "model_plane", "warm_ttft_p95_s"), False, 0.50),
+    ("models_materialize_p95_s",
+     ("details", "model_plane", "materialize_p95_s"), False, 0.50),
+    ("models_decode_recompiles",
+     ("details", "model_plane", "decode_recompiles"), False, 0.0),
+    ("models_fork_page_amplification",
+     ("details", "model_plane", "fork_page_amplification_vs_4x"),
+     False, 0.30),
 ]
 
 

@@ -121,12 +121,32 @@ with a bounded decision count (no flap), and the exported trace shows
 reads back.  ``trace_report --strict`` and ``timeline_export
 --validate`` must stay green over the same trace (CI wires all three).
 
+**Multimodel mode** (``python scripts/chaos_soak.py multimodel``, ISSUE
+18 acceptance gate): the model plane under chaos.  One engine serves
+its own weights plus THREE pool models (deferred-init skeletons,
+materialize-on-demand) from one page pool with ``max_resident=2`` —
+every third cold demand thrashes the LRU weight eviction — while a
+mixed wave interleaves all four models with parallel-sampling forks
+(``n`` up to 4), deadlines, cancels, and injected faults on every
+serving site **including ``serve.materialize``** (a failed
+materialization must retry next tick, skeleton intact).  A second
+engine is **killed mid-materialize** (``serve.materialize:1:fatal``):
+its queued work fails typed and a replacement engine re-registers the
+skeletons and serves the same requests token-identically.  Gates:
+every request token-identical to solo ``generate()`` under ITS model's
+weights (fork sibling *i* under ``fold_in(base, i)``) or failed typed;
+``audit.divergences == 0`` at 100% sampling; **zero decode recompiles
+after warmup** (same-geometry models share the compiled chunk); zero
+leaked pages / refcount drift; and the exported trace shows the
+``serve.materialize`` span plus ``serve.materializations``,
+``serve.model_evictions``, and ``serve.forks`` counters.
+
 CI (.github/workflows/ci.yaml, chaos-soak + fleet-chaos +
-autoscale-chaos jobs) runs all modes with ``TDX_TELEMETRY`` set.
-Locally:
+autoscale-chaos + multimodel-chaos jobs) runs all modes with
+``TDX_TELEMETRY`` set.  Locally:
 
     TDX_TELEMETRY=/tmp/chaos.jsonl JAX_PLATFORMS=cpu \\
-    python scripts/chaos_soak.py [fleet|migration|autoscale]
+    python scripts/chaos_soak.py [fleet|migration|autoscale|multimodel]
 """
 
 import json
@@ -1951,6 +1971,300 @@ def autoscale_main() -> int:
     return 0
 
 
+def multimodel_main() -> int:
+    """Model-plane chaos (ISSUE 18): three pool models + the engine's
+    own weights interleaved on one page pool under eviction thrash,
+    materialize faults, forks, deadlines, cancels, and a second engine
+    killed mid-materialize — token identity per model or typed failure,
+    zero divergences at 100% audit, zero decode recompiles after
+    warmup, zero leaked pages."""
+    trace = os.environ.get("TDX_TELEMETRY", "")
+    if not trace:
+        print("chaos_soak: set TDX_TELEMETRY", file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu import telemetry
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.models.generate import generate
+    from torchdistx_tpu.resilience import faults
+    from torchdistx_tpu.serving import (
+        Engine,
+        Health,
+        ModelPool,
+        RequestError,
+    )
+
+    cfg = llama.llama_test()
+    rng = np.random.default_rng(SEED)
+    TEMP, TOPK = 0.8, 8
+    # "Model <seed>": same family/cfg, different weights — identical KV
+    # geometry, so every model shares the engine's compiled programs.
+    SEEDS = {"default": 0, "m1": 1, "m2": 2, "m3": 3}
+    weights = {
+        tag: llama.init_params(jax.random.PRNGKey(s), cfg)
+        for tag, s in SEEDS.items()
+    }
+
+    def make_pool():
+        pool = ModelPool(max_resident=2)  # 3 models -> eviction thrash
+        for tag in ("m1", "m2", "m3"):
+            s = SEEDS[tag]
+            pool.register(
+                tag, model=llama, cfg=cfg,
+                materialize=(
+                    lambda s=s: llama.init_params(jax.random.PRNGKey(s),
+                                                  cfg)
+                ),
+            )
+        return pool
+
+    def make_engine():
+        return Engine(
+            weights["default"], model=llama, cfg=cfg, eos_id=EOS,
+            num_slots=4, block_size=8, num_blocks=41, max_model_len=64,
+            decode_chunk=4, max_queue=8 * N_REQUESTS,
+            drain_deadline_s=120.0, handle_preemption=False,
+            temperature=TEMP, top_k=TOPK, model_pool=make_pool(),
+        )
+
+    solo_cache = {}
+
+    def solo(tag, prompt, key_arr, max_new):
+        ck = (tag, prompt.tobytes(), key_arr.tobytes(), max_new)
+        if ck not in solo_cache:
+            toks = [
+                int(t) for t in np.asarray(
+                    generate(
+                        weights[tag], prompt[None], key_arr,
+                        model=llama, cfg=cfg, max_new_tokens=max_new,
+                        eos_id=EOS, temperature=TEMP, top_k=TOPK,
+                    )
+                )[0]
+            ]
+            if EOS in toks:
+                toks = toks[: toks.index(EOS) + 1]
+            solo_cache[ck] = toks
+        return solo_cache[ck]
+
+    def sibling_key(key, n, i):
+        base = jax.random.PRNGKey(key)
+        if n == 1:
+            return np.asarray(base).astype(np.uint32).reshape(2)
+        return np.asarray(
+            jax.random.fold_in(base, i)
+        ).astype(np.uint32).reshape(2)
+
+    def drive(eng, label):
+        for _ in range(MAX_STEPS):
+            if not (
+                len(eng.scheduler) or eng._n_running()
+                or eng.audit_backlog() or eng._materialize_wanted
+            ):
+                return None
+            eng.step()
+        return f"[{label}] drive loop exceeded {MAX_STEPS} steps (hang)"
+
+    # ---------------- Phase 1: warmup (compile every program) ----------------
+    eng = make_engine()
+    warm = []
+    for j, tag in enumerate(("default", "m1", "m2", "m3")):
+        p = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+        h = eng.submit(p, max_new_tokens=4, key=90_000 + j,
+                       model=None if tag == "default" else tag, n=2)
+        warm.append((tag, p, 4, 90_000 + j, 2, h.siblings))
+    err = drive(eng, "warmup")
+    if err:
+        return fail(err)
+    c0 = {
+        k: v for k, v in telemetry.snapshot()["counters"].items()
+        if k.startswith("compile.count") and "decode" in k
+    }
+
+    # ---------------- Phase 2: the interleaved soak ----------------
+    # Seeded faults over every serving site, serve.materialize included
+    # (a failed materialization retries next tick, skeleton intact).
+    specs = []
+    for site, hi, kinds in [
+        ("serve.admit", N_REQUESTS, ["io", "nan"]),
+        ("serve.prefill", N_REQUESTS, ["io", "nan"]),
+        ("serve.step", 4 * N_REQUESTS, ["io", "nan"]),
+        ("serve.materialize", max(4, N_REQUESTS // 4), ["io", "io", "nan"]),
+    ]:
+        for step in rng.integers(1, hi, size=6):
+            specs.append(f"{site}:{int(step)}:{rng.choice(kinds)}")
+    faults.reset(",".join(sorted(set(specs))))
+
+    reqs = []
+    tags = ("default", "m1", "m2", "m3")
+    for i in range(N_REQUESTS):
+        tag = tags[int(rng.integers(0, len(tags)))]
+        plen = int(rng.integers(3, 14))
+        p = rng.integers(0, cfg.vocab_size, size=plen).astype(np.int32)
+        mnt = int(rng.choice((4, 8, 12)))
+        n = int(rng.choice((1, 1, 1, 1, 2, 4)))
+        deadline = None if rng.random() > 0.05 else 1e-6
+        h = eng.submit(
+            p, max_new_tokens=mnt, key=i, deadline_s=deadline,
+            model=None if tag == "default" else tag, n=n,
+        )
+        sibs = h.siblings or [h]
+        if rng.random() < 0.05:
+            sibs[int(rng.integers(0, len(sibs)))].cancel()
+        reqs.append((tag, p, mnt, i, n, sibs))
+    err = drive(eng, "soak")
+    if err:
+        return fail(err)
+    faults.reset("")
+
+    n_ok = n_typed = 0
+    for tag, p, mnt, key, n, sibs in warm + reqs:
+        for i, h in enumerate(sibs):
+            if not h.done:
+                return fail(f"request {key}.{i} neither finished nor failed")
+            if h.error is not None:
+                if not isinstance(h.error, RequestError):
+                    return fail(
+                        f"request {key}.{i} ({tag}) failed UNTYPED: "
+                        f"{type(h.error).__name__}: {h.error}"
+                    )
+                n_typed += 1
+            else:
+                if h.result() != solo(tag, p, sibling_key(key, n, i), mnt):
+                    return fail(
+                        f"request {key}.{i} ({tag}, n={n}) diverged "
+                        "from solo generate() under its model's weights"
+                    )
+                n_ok += 1
+    if n_ok < N_REQUESTS // 2:
+        return fail(f"only {n_ok} requests completed — soak too lossy")
+    # Zero decode recompiles after warmup: every model shares the
+    # engine's compiled chunk (same geometry, static-arg identity).
+    c1 = {
+        k: v for k, v in telemetry.snapshot()["counters"].items()
+        if k.startswith("compile.count") and "decode" in k
+    }
+    grew = {k: v - c0.get(k, 0) for k, v in c1.items() if v != c0.get(k, 0)}
+    if grew:
+        return fail(f"steady-state decode recompiled: {grew}")
+    if eng.allocator.num_in_use != len(eng.prefix):
+        return fail(
+            f"soak leaked pages: {eng.allocator.num_in_use} in use vs "
+            f"{len(eng.prefix)} indexed"
+        )
+    drift = eng.prefix.check(eng.allocator)
+    if drift is not None:
+        return fail(f"soak refcount drift: {drift}")
+    if eng.health() is not Health.READY:
+        return fail(f"engine health {eng.health()} != READY after soak")
+    st = eng.stats()["models"]
+    if st["n_registered"] != 3:
+        return fail(f"pool lost skeletons: {st}")
+    evictions = sum(m["evictions"] for m in st["models"].values())
+    if evictions < 1:
+        return fail("max_resident=2 over 3 interleaved models never "
+                    f"evicted: {st}")
+    print(
+        f"chaos_soak: multimodel soak OK — {n_ok} token-identical, "
+        f"{n_typed} typed failures, {evictions} evictions, "
+        f"{st['materialize_retries']} materialize retries, 0 decode "
+        f"recompiles (seed={SEED}, n={N_REQUESTS})"
+    )
+
+    # ---------------- Phase 3: killed mid-materialize ----------------
+    # serve.materialize:1:fatal is the in-process stand-in for a crash
+    # inside the weight load: the fault fires INSIDE the materialize
+    # span with nothing allocated, the engine dies with queued work,
+    # and a replacement re-registers the skeletons and serves the same
+    # requests token-identically.
+    eng2 = make_engine()
+    victims = []
+    for j in range(3):
+        p = rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+        victims.append((p, 95_000 + j))
+    faults.reset("serve.materialize:1:fatal")
+    handles = [
+        eng2.submit(p, max_new_tokens=4, key=k, model="m1")
+        for p, k in victims
+    ]
+    died = False
+    try:
+        for _ in range(MAX_STEPS):
+            eng2.step()
+            if all(h.done for h in handles):
+                break
+    except faults.FatalInjectedFault:
+        died = True
+    faults.reset("")
+    if not died:
+        return fail("serve.materialize:1:fatal never fired")
+    if eng2.model_pool.ready("m1"):
+        return fail("killed materialization left weights behind")
+    eng2.close()  # queued work fails typed and retryable
+    for h in handles:
+        if h.error is not None and not isinstance(h.error, RequestError):
+            return fail(
+                f"kill-mid-materialize failed a request UNTYPED: "
+                f"{type(h.error).__name__}"
+            )
+    eng3 = make_engine()  # the replacement re-registers the skeletons
+    replays = [
+        eng3.submit(p, max_new_tokens=4, key=k, model="m1")
+        for p, k in victims
+    ]
+    err = drive(eng3, "replacement")
+    if err:
+        return fail(err)
+    for (p, k), h in zip(victims, replays):
+        if h.result() != solo("m1", p, sibling_key(k, 1, 0), 4):
+            return fail(f"replacement diverged on request {k}")
+    if eng3.model_pool.stats()["models"]["m1"]["materializations"] != 1:
+        return fail("replacement materialized m1 more than once")
+    eng3.drain()
+    if eng3.allocator.num_in_use != len(eng3.prefix):
+        return fail("replacement engine leaked pages")
+    print(
+        "chaos_soak: multimodel kill-mid-materialize OK — typed "
+        "failures, replacement token-identical"
+    )
+
+    # ---------------- Drain + trace assertions ----------------
+    eng.close()
+    eng3.close()
+    telemetry.emit_counters()
+    spans, counters, dumps, events = parse_trace(trace)
+    if "serve.materialize" not in spans:
+        return fail("trace missing the serve.materialize span")
+    if counters.get("serve.materializations", 0) < 3:
+        return fail("trace shows fewer than 3 serve.materializations")
+    if counters.get("serve.model_evictions", 0) < 1:
+        return fail("trace shows no serve.model_evictions")
+    if counters.get("serve.forks", 0) < 1:
+        return fail("trace shows no serve.forks")
+    if not events.get("model.materialized"):
+        return fail("trace has no model.materialized events")
+    if AUDITING:
+        if counters.get("audit.checked", 0) < 1:
+            return fail("TDX_AUDIT_SAMPLE set but no audit.checked in trace")
+        if counters.get("audit.divergences", 0) != 0:
+            return fail(
+                f"audit.divergences = {counters.get('audit.divergences')} "
+                "!= 0 in the multimodel soak"
+            )
+    print(
+        "chaos_soak: multimodel trace OK — "
+        f"materializations={counters.get('serve.materializations')}, "
+        f"evictions={counters.get('serve.model_evictions')}, "
+        f"forks={counters.get('serve.forks')}, "
+        f"audit.checked={counters.get('audit.checked', 0)}"
+    )
+    return 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         sys.exit(fleet_main())
@@ -1958,4 +2272,6 @@ if __name__ == "__main__":
         sys.exit(migration_main())
     if len(sys.argv) > 1 and sys.argv[1] == "autoscale":
         sys.exit(autoscale_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "multimodel":
+        sys.exit(multimodel_main())
     sys.exit(main())

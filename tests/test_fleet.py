@@ -640,3 +640,65 @@ def test_genuinely_empty_fleet_still_fails_typed():
     with pytest.raises(NoReplicaAvailable) as ei:
         router.submit(prompt_of(4), max_new_tokens=2, key=0)
     assert ei.value.retryable
+
+
+# ---------------------------------------------------------------------------
+# Model plane over the fleet (ISSUE 18)
+
+
+def test_killed_replica_forwards_model_and_n(family):
+    """A pool-model fork stream (``submit(model=..., n=...)``) whose
+    replica is killed mid-stream must re-place with BOTH forwarded —
+    the peer materializes the same weights on demand and the parent
+    stream (sibling 0, key ``fold_in(base, 0)``) continues
+    token-identically.  Pinned two ways: the bound engine's live
+    Request carries the model tag, and the fork group exists on the
+    replacement replica after failover."""
+    from torchdistx_tpu.serving import ModelPool
+
+    model, cfg, params = family
+
+    def pooled_engine():
+        pool = ModelPool()
+        pool.register(
+            "tuna", model=model, cfg=cfg,
+            materialize=lambda: llama.init_params(jax.random.PRNGKey(9),
+                                                  cfg),
+        )
+        return make_engine(family, num_slots=4, temperature=0.7, top_k=8,
+                           eos_id=EOS, model_pool=pool)
+
+    eng_a, eng_b = pooled_engine(), pooled_engine()
+    router = FleetRouter([eng_a, eng_b], version="v1", max_hops=3)
+    h = router.submit(prompt_of(6), max_new_tokens=10, key=3,
+                      model="tuna", n=2)
+    assert h.replica_id == 0 and h.model == "tuna" and h.n == 2
+    g = h.tokens()
+    first = [next(g)]
+    # The bound engine's request carries the model tag, and the fork
+    # group (parent + 1 sibling) landed there.
+    live_tags = {r.model_tag for r in eng_a._slot_req if r is not None}
+    assert "tuna" in live_tags
+    assert eng_a.stats()["forks"] == 1
+    eng_a.close()  # the serving replica dies mid-stream
+    rest = list(g)
+    # Token parity: sibling 0 of an n=2 fork samples under
+    # fold_in(base, 0) — on the peer's on-demand-materialized weights.
+    p9 = llama.init_params(jax.random.PRNGKey(9), cfg)
+    k0 = np.asarray(
+        jax.random.fold_in(jax.random.PRNGKey(3), 0)
+    ).astype(np.uint32).reshape(2)
+    out = generate(
+        p9, jnp.asarray(prompt_of(6))[None], k0, model=model, cfg=cfg,
+        max_new_tokens=10, eos_id=EOS, temperature=0.7, top_k=8,
+    )
+    expect = [int(t) for t in np.asarray(out)[0]]
+    if EOS in expect:
+        expect = expect[: expect.index(EOS) + 1]
+    assert first + rest == expect
+    assert h.replica_id == 1 and h.hops == 1
+    assert eng_b.model_pool.ready("tuna")  # materialized on demand
+    assert eng_b.stats()["forks"] == 1  # n rode the re-submission
+    eng_b.drain()
+    assert eng_a.allocator.num_in_use == 0
+    assert eng_b.allocator.num_in_use == 0

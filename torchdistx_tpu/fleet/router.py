@@ -204,6 +204,8 @@ class FleetHandle:
         max_hops: int,
         tenant: str = "default",
         priority: int = 0,
+        model: Optional[str] = None,
+        n: int = 1,
     ):
         self._router = router
         self._prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -215,6 +217,15 @@ class FleetHandle:
         # peer (inert on FIFO-scheduled engines).
         self.tenant = str(tenant)
         self.priority = int(priority)
+        # Model-plane context (docs/serving.md, "Model plane"): the
+        # target pool model and the fork fan-out, pinned exactly like
+        # tenant/priority and forwarded on EVERY re-submission.  This
+        # handle streams sibling 0 (the parent); its key is
+        # fold_in(base, 0) when n > 1 — deterministic on any replica,
+        # so failover replay stays token-identical.  Siblings on a dead
+        # replica die with it and are re-forked by the re-submission.
+        self.model = model
+        self.n = int(n)
         self._deadline = (
             time.perf_counter() + deadline_s if deadline_s is not None else None
         )
@@ -395,6 +406,8 @@ class FleetHandle:
                     deadline_s=self._remaining_deadline_s(),
                     tenant=self.tenant,
                     priority=self.priority,
+                    model=self.model,
+                    n=self.n,
                     trace_id=self.trace_id,
                     hop=self.hops,
                 )
@@ -412,12 +425,19 @@ class FleetHandle:
                 continue
             self.replica_id = rep.rid
             self.version = rep.version
-            self._model_version = getattr(rep.engine, "model_version", "v0")
+            # The version folded into every digest token: the pool
+            # entry's model_version for a pool model (the request
+            # carries it), the engine's own otherwise.
+            req = getattr(self._inner, "_req", None)
+            self._model_version = (
+                getattr(req, "model_version", None)
+                or getattr(rep.engine, "model_version", "v0")
+            )
             if self._digest is None:
                 # Seed from the engine-normalized key so the fleet's
                 # digest and the engine's request digests hash the same
-                # bytes for the same submit(key=...).
-                req = getattr(self._inner, "_req", None)
+                # bytes for the same submit(key=...) — including the
+                # fold_in(base, 0) sibling-0 key when n > 1.
                 self._digest = _audit.DeterminismDigest(
                     self._prompt,
                     req.key if req is not None
@@ -991,6 +1011,8 @@ class FleetRouter:
         max_hops: Optional[int] = None,
         tenant: str = "default",
         priority: int = 0,
+        model: Optional[str] = None,
+        n: int = 1,
     ) -> FleetHandle:
         """Route a request to the best replica; returns its streaming
         :class:`FleetHandle`.
@@ -1003,7 +1025,13 @@ class FleetRouter:
         (see :mod:`torchdistx_tpu.serving.qos`), pinned on the handle
         and forwarded with every re-submission — a stream preempted on
         one replica and failed over to another keeps its class and its
-        tenant's fair-queueing share.  Raises
+        tenant's fair-queueing share.  ``model`` / ``n`` are the
+        model-plane context (docs/serving.md, "Model plane"): the pool
+        model to serve from and the parallel-sampling fan-out, pinned
+        on the handle and forwarded on every re-submission exactly like
+        tenant/priority — the handle streams the fork parent (sibling
+        0), whose ``fold_in(base, 0)`` key replays identically on any
+        peer.  Raises
         :class:`NoReplicaAvailable` (typed, retryable) when no replica
         can take it, and plain ``ValueError`` for requests that could
         never run anywhere (engine validation)."""
@@ -1019,6 +1047,8 @@ class FleetRouter:
             self.max_hops if max_hops is None else max_hops,
             tenant=tenant,
             priority=priority,
+            model=model,
+            n=n,
         )
         if _telemetry.events_enabled():
             # The fleet-level submission opens the request's timeline —
@@ -1035,6 +1065,8 @@ class FleetRouter:
                 max_new=int(max_new_tokens),
                 tenant=handle.tenant,
                 priority=handle.priority,
+                model=handle.model,
+                n=handle.n,
                 deadline_s=deadline_s,
             )
         _T_SUBMITTED.add()

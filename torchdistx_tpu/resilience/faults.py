@@ -44,6 +44,12 @@ Sites (where the stack asks):
   attempt).  ``io``/``nan`` fail the import: the partial page set is
   freed on the destination (no leak) and the stream falls back to a
   cold key-pinned replay — no double-serve, token-identical either way.
+* ``serve.materialize`` — before the model pool materializes one
+  registered model's weights (step = materialize attempt).  ``io``/
+  ``nan`` fail that attempt: the model stays a skeleton (no partial
+  weights, no ledger row) and the next tick with demand retries;
+  ``crash`` is the kill-mid-materialize drill — the process dies with
+  nothing registered, so recovery starts from the skeleton.
 
 Kinds (what happens):
 
@@ -113,6 +119,7 @@ SITES = frozenset(
         "serve.swap",
         "serve.migrate_out",
         "serve.migrate_in",
+        "serve.materialize",
     }
 )
 KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan", "corrupt"})

@@ -24,6 +24,11 @@ allocated per row up front.  This package replaces that for serving:
   first ordering, and the shed-by-priority overload policy; the engine
   pairs it with preemption of running lower-class streams
   (swap-to-host / drop-and-replay, both token-identical on resume);
+* :mod:`.modelpool` — the model plane (``Engine(model_pool=...)``):
+  many models on one engine's page pool — deferred-init skeleton
+  registry (near-zero HBM until demand), materialize-on-first-request,
+  ledger-driven LRU weight eviction under HBM pressure; pairs with
+  ``submit(model=..., n=...)`` copy-on-write parallel sampling;
 * :mod:`.lifecycle` — the request-lifecycle robustness layer: typed
   errors (deadline, cancel, shed, preempt, recovery), the
   :class:`~.lifecycle.Health` state machine
@@ -68,6 +73,7 @@ from .cache import (  # noqa: F401
     write_prompt,
 )
 from .engine import Engine  # noqa: F401
+from .modelpool import DEFAULT_MODEL, ModelPool  # noqa: F401
 from .qos import QoSScheduler  # noqa: F401
 from .lifecycle import (  # noqa: F401
     DeadlineExceeded,
@@ -87,6 +93,7 @@ from .scheduler import FIFOScheduler, Request, RequestHandle  # noqa: F401
 
 __all__ = [
     "BlockAllocator",
+    "DEFAULT_MODEL",
     "DeadlineExceeded",
     "DeterminismDiverged",
     "Engine",
@@ -95,6 +102,7 @@ __all__ = [
     "FIFOScheduler",
     "Health",
     "MigrationIncompatible",
+    "ModelPool",
     "OverloadDetector",
     "PrefixIndex",
     "QoSScheduler",

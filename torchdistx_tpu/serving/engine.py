@@ -113,8 +113,10 @@ drop-and-replay; ``serve.migrate_out`` — fails one stream-migration
 export before its page gather (the source stream keeps running,
 untouched); ``serve.migrate_in`` — fails one migration import after the
 destination allocated pages but before the scatter (the partial page set
-frees, the stream falls back to cold replay).  ``fatal`` propagates
-everywhere: fatal means fatal.
+frees, the stream falls back to cold replay); ``serve.materialize`` —
+fails one model-pool weight materialization attempt (the skeleton is
+untouched, the next tick with demand retries; see :mod:`.modelpool`).
+``fatal`` propagates everywhere: fatal means fatal.
 """
 
 from __future__ import annotations
@@ -159,6 +161,7 @@ from .lifecycle import (
     RequestCancelled,
     RequestPreempted,
 )
+from .modelpool import DEFAULT_MODEL, ModelPool
 from .prefix import PrefixIndex, page_hashes
 from .qos import QoSScheduler
 from .scheduler import FIFOScheduler, Request, RequestHandle
@@ -189,6 +192,7 @@ _T_CORRUPTIONS = _telemetry.counter("serve.corruptions")
 _T_MIGRATIONS_OUT = _telemetry.counter("serve.migrations_out")
 _T_MIGRATIONS_IN = _telemetry.counter("serve.migrations_in")
 _T_MIGRATED_PAGES = _telemetry.counter("serve.migrated_pages")
+_T_FORKS = _telemetry.counter("serve.forks")
 _G_RUNNING = _telemetry.gauge("serve.running_slots")
 _G_DECODE_TPS = _telemetry.gauge("serve.decode_tok_s")
 _G_TTFT = _telemetry.gauge("serve.ttft_s")
@@ -448,6 +452,19 @@ class Engine:
         routed around like a stall — until :meth:`clear_divergence`),
         and flight-dumps ``reason="divergence"`` with both token
         streams for ``scripts/incident_replay.py`` to bisect.
+    model_pool : a :class:`~.modelpool.ModelPool` of deferred-init
+        skeleton models to serve ALONGSIDE this engine's own model,
+        all decoding into this one page pool (docs/serving.md, "Model
+        plane").  Binding validates every registered skeleton's KV page
+        geometry against the live pool; ``submit(model=tag)`` then
+        routes traffic per model — weights materialize on first demand
+        (one model per tick, after the decode dispatch, so a cold
+        model's load stall never blocks a hot model's token cadence)
+        and evict LRU under the pool's residency knobs.  Each model's
+        ``model_version`` seeds its requests' determinism digests, and
+        the prefix index namespaces page hashes by model tag — two
+        models never share a KV page or a digest.  None (default):
+        single-model engine, bit-identical behavior.
     """
 
     def __init__(
@@ -483,6 +500,7 @@ class Engine:
         role: str = "mixed",
         model_version: str = "v0",
         audit_sample: Optional[float] = None,
+        model_pool: Optional[ModelPool] = None,
     ):
         self.model = model
         self.cfg = cfg
@@ -622,6 +640,23 @@ class Engine:
         # layout) where layout[i] is the kept page id or None for the
         # i-th table position (None rows match host-buffer order).
         self._swapped: dict[int, tuple] = {}
+        # Parallel sampling (submit(n=4), docs/serving.md "Model plane"):
+        # per fork group, the ENGINE-held share references on the
+        # parent's prompt-covering pages — created when the parent's
+        # prefill completes, so siblings admitted later map them without
+        # re-prefilling even if the parent has already retired.  Swept
+        # once every sibling is terminal (_reap_phase); freed wholesale
+        # at drain; cleared without frees after an allocator reset (the
+        # pages died with the pool).  parent rid -> [page ids].
+        self._fork_donors: dict[int, list] = {}
+        # parent rid -> the sibling Requests of the group (parent
+        # excluded — the donor exists for THEM).
+        self._fork_groups: dict[int, list] = {}
+        # Cold pool models with demand (submit seen / admission head
+        # held), in demand order: the materialize phase serves ONE per
+        # tick, after the decode dispatch.  Insertion-ordered dict used
+        # as an ordered set.
+        self._materialize_wanted: dict[str, None] = {}
 
         self._next_rid = 0
         self._admit_no = 0  # admission attempts (serve.admit fault site)
@@ -645,6 +680,7 @@ class Engine:
         self._n_migrated_out = 0
         self._n_migrated_in = 0
         self._n_cow = 0
+        self._n_forks = 0
 
         # Per-engine labeled metrics (docs/observability.md): N fleet
         # replicas in one process each get their own readings instead of
@@ -700,6 +736,15 @@ class Engine:
             if audit_sample
             else None
         )
+
+        # Model plane (docs/serving.md, "Model plane"): bind the pool —
+        # geometry validation for every registered skeleton happens
+        # here, BEFORE the ops-plane attach and the perf-plane
+        # registrations, so an incompatible model rejects the
+        # constructor rather than the first unlucky request.
+        self.model_pool = model_pool
+        if model_pool is not None:
+            model_pool._bind(self)
 
         # Live ops plane (docs/observability.md, "Ops plane").  The
         # tick counter always counts (one int add — the watchdog's
@@ -806,11 +851,32 @@ class Engine:
         deadline_s: Optional[float] = None,
         tenant: str = "default",
         priority: int = 0,
+        model: Optional[str] = None,
+        n: int = 1,
         trace_id: Optional[str] = None,
         hop: int = 0,
         _audit_of: Optional[str] = None,
     ) -> RequestHandle:
         """Queue a request; returns its streaming handle.
+
+        ``model``: a tag registered on this engine's
+        :class:`~.modelpool.ModelPool` — the request decodes under THAT
+        model's weights (materialized on demand) with its
+        ``model_version`` seeding the determinism digest and its tag
+        namespacing the prefix-cache page hashes.  None (default): the
+        engine's own construction-time model, unchanged semantics.
+
+        ``n``: parallel samples of this one prompt (``n > 1`` forks the
+        request into ``n`` siblings).  Siblings SHARE the parent's
+        prompt pages — the prompt prefills once; each fork pays only
+        its marginal pages, diverging copy-on-write — and sample
+        independently: sibling ``i``'s key is ``fold_in(key, i)``, so
+        each is token-identical to a solo ``submit`` with that folded
+        key (``n == 1`` leaves the key untouched).  The returned handle
+        is sibling 0; ``handle.siblings`` lists all ``n`` handles in
+        index order.  Each sibling is its own request end to end — own
+        deadline, own digest, own lifecycle — cancel one and the rest
+        keep decoding.
 
         ``trace_id`` / ``hop``: the request-scoped trace context (see
         docs/observability.md).  A router forwards ONE id across every
@@ -867,6 +933,30 @@ class Engine:
         if not tenant:
             raise ValueError("tenant must be a non-empty string")
         priority = int(priority)
+        n = int(n)
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        # Model resolution BEFORE any shedding side effect (same rule as
+        # the key below): an unknown tag must raise without having
+        # killed a drop-oldest victim.
+        model = DEFAULT_MODEL if model is None else str(model)
+        pool_entry = None
+        if model != DEFAULT_MODEL:
+            if self.model_pool is None:
+                raise ValueError(
+                    f"submit(model={model!r}) needs an Engine constructed "
+                    "with model_pool=ModelPool(...)"
+                )
+            if model not in self.model_pool:
+                raise ValueError(
+                    f"model {model!r} is not registered on this engine's "
+                    f"pool; known tags: {self.model_pool.tags()}"
+                )
+            pool_entry = self.model_pool._entries[model]
+        model_version = (
+            pool_entry.model_version if pool_entry is not None
+            else self.model_version
+        )
         # Normalize the key BEFORE any shedding side effect: a malformed
         # key must raise without having killed a drop-oldest victim.
         if key is None:
@@ -878,6 +968,13 @@ class Engine:
             raise EngineDraining(
                 f"engine is {self._health.value}; submit to another replica"
             )
+        if pool_entry is not None:
+            # Demand noted now (LRU clock + the materialize queue when
+            # cold): the weights can be loading while the request waits
+            # its turn in the queue.
+            self.model_pool._touch(model)
+            if not pool_entry.ready:
+                self._materialize_wanted[model] = None
         # Prefill cost in chunks: the TTFT estimate drains the queue at
         # max_prefills_per_tick CHUNKS per tick, so a long prompt must
         # weigh as many chunks, not 1.  A prefix-cache hit shrinks the
@@ -887,8 +984,13 @@ class Engine:
         hashes = None
         if self.prefix is not None:
             # Hashed ONCE per request: admission reuses these (the hash
-            # is a pure function of the prompt).
-            hashes = page_hashes(prompt, self.block_size)
+            # is a pure function of the prompt — and of the MODEL: pool
+            # models namespace the chain with their tag, so the same
+            # prompt under two models can never share a page).
+            hashes = page_hashes(
+                prompt, self.block_size,
+                pool_entry.namespace if pool_entry is not None else b"",
+            )
             suffix = max(
                 1, len(prompt) - self.prefix.probe(hashes) * self.block_size
             )
@@ -948,43 +1050,94 @@ class Engine:
                         )
                     )
 
-        rid = self._next_rid
-        self._next_rid += 1
-        handle = RequestHandle(self, rid)
         deadline = (
             time.perf_counter() + deadline_s if deadline_s is not None else None
         )
-        if trace_id is None and _telemetry.events_enabled():
-            trace_id = f"{self.engine_id}-r{rid}"
-        req = Request(
-            rid, prompt, int(max_new_tokens), key, handle,
-            deadline=deadline, n_chunks=n_chunks, hashes=hashes,
-            tenant=tenant, priority=priority,
-            trace_id=trace_id, hop=int(hop),
-            digest=_audit.DeterminismDigest(prompt, key),
-            audit_of=_audit_of,
-        )
-        handle._req = req
-        # Traced requests carry their replay identity (prompt ids +
-        # normalized key) on req.submitted so a flight dump is a
-        # runnable repro (scripts/incident_replay.py); built ONLY when
-        # tracing — the disabled path allocates no lists.
-        extra = {}
-        if trace_id is not None:
-            extra["prompt"] = [int(t) for t in prompt]
-            extra["key"] = [int(k) for k in key]
-            if _audit_of is not None:
-                extra["audit_of"] = _audit_of
-        self._event(
-            "req.submitted", req,
-            n_prompt=len(prompt), max_new=int(max_new_tokens),
-            tenant=tenant, priority=priority,
-            deadline_s=deadline_s, n_chunks=n_chunks, **extra,
-        )
-        self.scheduler.push(req)
-        self._event("req.queued", req, queue_depth=len(self.scheduler))
-        _T_REQUESTS.add()
-        return handle
+        base_key = key
+        handles: list[RequestHandle] = []
+        reqs: list[Request] = []
+        parent_rid = self._next_rid
+        for i in range(n):
+            rid = self._next_rid
+            self._next_rid += 1
+            # Sibling key schedule: fold_in(base, i) for EVERY group
+            # member, so sibling i is token-identical to a solo submit
+            # with key=fold_in(key, i) — and the digest is built from
+            # the folded key, so an audit replay (resubmitted n=1 with
+            # the recorded key) hashes to the same identity.  n == 1
+            # keeps the caller's key untouched: solo submissions stay
+            # bit-compatible with the pre-fork engine.
+            k = (
+                base_key if n == 1
+                else np.asarray(
+                    jax.random.fold_in(base_key, i)
+                ).astype(np.uint32).reshape(2)
+            )
+            handle = RequestHandle(self, rid)
+            tid = trace_id
+            if tid is None:
+                if _telemetry.events_enabled():
+                    tid = f"{self.engine_id}-r{rid}"
+            elif i > 0:
+                # A caller-pinned id stays unique per sibling: the fork
+                # index suffixes it, so the n timelines reconstruct
+                # separately under one visible group prefix.
+                tid = f"{trace_id}.f{i}"
+            req = Request(
+                rid, prompt, int(max_new_tokens), k, handle,
+                deadline=deadline,
+                # Siblings ride the parent's prompt pages: their true
+                # marginal prefill is one last-token chunk — the WFQ
+                # fare and the TTFT estimate must charge that, not the
+                # full prompt.
+                n_chunks=n_chunks if i == 0 else 1,
+                hashes=hashes,
+                tenant=tenant, priority=priority,
+                trace_id=tid, hop=int(hop),
+                digest=_audit.DeterminismDigest(prompt, k),
+                audit_of=_audit_of,
+                model_tag=model, model_version=model_version,
+                fork_of=None if i == 0 else parent_rid,
+                fork_index=i,
+            )
+            handle._req = req
+            # Traced requests carry their replay identity (prompt ids +
+            # normalized key) on req.submitted so a flight dump is a
+            # runnable repro (scripts/incident_replay.py); built ONLY
+            # when tracing — the disabled path allocates no lists.
+            extra = {}
+            if tid is not None:
+                extra["prompt"] = [int(t) for t in prompt]
+                extra["key"] = [int(kk) for kk in k]
+                if _audit_of is not None:
+                    extra["audit_of"] = _audit_of
+            if model != DEFAULT_MODEL:
+                extra["model"] = model
+            if n > 1:
+                extra["n"] = n
+                extra["fork_index"] = i
+            self._event(
+                "req.submitted", req,
+                n_prompt=len(prompt), max_new=int(max_new_tokens),
+                tenant=tenant, priority=priority,
+                deadline_s=deadline_s, n_chunks=req.n_chunks, **extra,
+            )
+            handles.append(handle)
+            reqs.append(req)
+        if n > 1:
+            self._n_forks += n - 1
+            _T_FORKS.add(n - 1)
+            self._fork_groups[parent_rid] = reqs[1:]
+            siblings = list(handles)
+            for h in handles:
+                h.siblings = siblings
+        for req in reqs:
+            self.scheduler.push(req)
+            self._event("req.queued", req, queue_depth=len(self.scheduler))
+            _T_REQUESTS.add()
+            if pool_entry is not None:
+                self.model_pool._note_request(model)
+        return handles[0]
 
     def drain(self) -> None:
         """Step until every submitted request has finished — shadow
@@ -1028,6 +1181,92 @@ class Engine:
                 left = max(1, req.replay_len() - req.prefill_pos)
                 pending += -(-left // self.prefill_chunk)
         return pending
+
+    # ------------------------------------------------------------------
+    # Model plane (docs/serving.md, "Model plane")
+
+    def _model_ready(self, req: Request) -> bool:
+        """Admission gate: can ``req``'s model serve RIGHT NOW?  A cold
+        pool model holds the queue head WITHOUT popping it — and notes
+        the demand, so the materialize phase loads the weights
+        out-of-band and the head admits on a later tick."""
+        if req.model_tag == DEFAULT_MODEL:
+            return True
+        entry = self.model_pool._entries.get(req.model_tag)
+        if entry is None or entry.ready:
+            return True
+        self._materialize_wanted.setdefault(req.model_tag, None)
+        self.model_pool._note_stall(req.model_tag)
+        return False
+
+    def _page_need(self, req: Request) -> int:
+        """Admission page reservation for ``req`` — a fork sibling with
+        a live donor charges only its MARGINAL pages (the generation
+        tail); everything else charges the full quota."""
+        n_total = blocks_needed(req.cache_tokens, self.block_size)
+        if req.fork_of is not None and not req.handle._tokens:
+            donor = self._fork_donors.get(req.fork_of)
+            if donor is not None:
+                return max(0, n_total - len(donor))
+        return n_total
+
+    def _model_ctx(self, tag: str) -> tuple:
+        """The ``(model, cfg, params)`` triple a dispatch for ``tag``
+        runs under.  Pool models must be resident: admission gates on
+        residency and eviction refuses models with live slots, so a
+        miss here means external interference — fail loudly."""
+        if tag == DEFAULT_MODEL:
+            return self.model, self.cfg, self._params
+        entry = self.model_pool._entries[tag]
+        if entry.params is None:
+            raise RuntimeError(
+                f"model {tag!r} lost its weights with live work on the "
+                "engine (evicted externally mid-flight?)"
+            )
+        return entry.model, entry.cfg, entry.params
+
+    def _model_in_use(self, tag: str) -> bool:
+        """True while any SLOT — running, prefilling, or swapped out —
+        serves ``tag``: the model-pool eviction pin.  Queued requests
+        don't pin: admission re-demands materialization."""
+        return any(
+            req is not None and req.model_tag == tag
+            for req in self._slot_req
+        )
+
+    def _materialize_phase(self) -> None:
+        """Materialize the oldest demanded cold model — ONE per tick.
+        A transient failure (``serve.materialize`` ``io``/``nan``, a
+        flaky checkpoint read) leaves the skeleton untouched and the
+        demand queued: the next tick retries.  Anything else propagates
+        out of ``step()`` — a factory that cannot produce weights is an
+        operator problem, not a retry loop."""
+        tag = next(iter(self._materialize_wanted))
+        try:
+            self.model_pool.ensure(tag)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except faults.FatalInjectedFault:
+            raise
+        except OSError:
+            self.model_pool.materialize_retries += 1
+            return
+        self._materialize_wanted.pop(tag, None)
+
+    def _sweep_fork_donors(self) -> None:
+        """Release a fork group's donor pages once every sibling is
+        terminal — nothing will ever map them again.  The parent does
+        not pin its own donor (it holds its own references)."""
+        if not self._fork_groups:
+            return
+        for gid in list(self._fork_groups):
+            if all(
+                req.handle._done for req in self._fork_groups[gid]
+            ):
+                donor = self._fork_donors.pop(gid, None)
+                if donor:
+                    self.allocator.free(donor)
+                del self._fork_groups[gid]
 
     def begin_drain(self) -> None:
         """Start a graceful drain NOW, without a preemption signal.
@@ -1115,6 +1354,18 @@ class Engine:
         committed = self._decode_phase()
         if timer is not None:
             timer.begin("schedule")
+        if self._fork_groups:
+            # A fork group whose last sibling retired in THIS tick's
+            # decode frees its donor pages now, not next tick — a
+            # drive-until-idle loop (drain()) must settle to zero pages
+            # the tick the work completes.
+            self._sweep_fork_donors()
+        if self._materialize_wanted and self._health is not Health.DRAINING:
+            # Model plane: serve ONE cold model's weight demand, strictly
+            # AFTER this tick's decode dispatch — the materialize stall
+            # lands between ticks, so a cold model's arrival never
+            # freezes a hot model's token cadence mid-tick.
+            self._materialize_phase()
         if self._health is Health.DRAINING:
             self._drain_tick()
         elif self._health is Health.STARTING:
@@ -1360,6 +1611,7 @@ class Engine:
                         f"{self._emitted[slot]} tokens"
                     ),
                 )
+        self._sweep_fork_donors()
 
     def _fail_running_slot(self, slot: int, error) -> None:
         """Abort a running slot: pages back, handle failed typed, slot
@@ -1391,6 +1643,9 @@ class Engine:
         # engine/run in this process starts clean; a platform that is
         # really going down keeps signalling.
         _preemption.clear()
+        # Pending weight demand dies with the queue it served: the
+        # requests that wanted those models are flushed below.
+        self._materialize_wanted.clear()
         for req in self.scheduler.flush():
             self._n_preempted += 1
             _T_PREEMPTED.add()
@@ -1428,6 +1683,12 @@ class Engine:
         if self._drain_sp is not None:
             self._drain_sp.end(timed_out=timed_out)
             self._drain_sp = None
+        # Fork donors die with the engine, same rule as cached prefixes:
+        # drop the engine-held share references so nothing stays mapped.
+        for donor in self._fork_donors.values():
+            self.allocator.free(donor)
+        self._fork_donors.clear()
+        self._fork_groups.clear()
         if self.prefix is not None:
             # Cached prefixes die with the engine: drop the index's page
             # references so a stopped engine owns nothing.
@@ -1481,6 +1742,10 @@ class Engine:
         if left <= 0:
             _perf.ledger.unregister("weights", owner=self._weights_key)
         self._weights_anchor = None  # release the id pin with the entry
+        # Model-plane teardown: pool models' weights, ledger rows, and
+        # per-engine labeled families all leave with the engine.
+        if self.model_pool is not None:
+            self.model_pool._close()
 
     def close(self) -> None:
         """Stop the engine NOW: fail queued and in-flight work with
@@ -1571,6 +1836,7 @@ class Engine:
         batch = self.scheduler.pop_admissible(
             len(free_slots), self.allocator, self.block_size,
             reclaim=self._reclaim_pages,
+            need=self._page_need, ready=self._model_ready,
         )
         for i, req in enumerate(batch):
             try:
@@ -1598,7 +1864,10 @@ class Engine:
         happens while any prefilling slot is the head's class or above
         — chunk progress is never sacrificed to an equal."""
         head = self.scheduler.peek()
-        if head is None:
+        if head is None or not self._model_ready(head):
+            # A cold-model head cannot admit this tick: aborting chunk
+            # progress for it would be pure waste (the materialize phase
+            # was just notified; next tick it outranks for real).
             return
         if not all(
             self._slot_req[slot].priority < head.priority
@@ -1653,7 +1922,9 @@ class Engine:
           the failed swap leaves device state untouched.
         """
         head = self.scheduler.peek()
-        if head is None:
+        if head is None or not self._model_ready(head):
+            # Same cold-model rule as _preempt_prefills: never preempt
+            # running streams for a head that cannot admit this tick.
             return
         victims = sorted(
             (
@@ -1793,7 +2064,7 @@ class Engine:
             req = self._slot_req[slot]
             toks = req.handle._tokens
             if toks and not req.digest.matches_stream(
-                req.prompt, req.key, toks, self.model_version
+                req.prompt, req.key, toks, req.model_version
             ):
                 # Digest verification before the pages come back: a
                 # corrupted committed buffer fails typed here — the
@@ -1934,7 +2205,11 @@ class Engine:
             "n_pages": n_pages,
             "geometry": pool_geometry(self._cache),
             "block_size": self.block_size,
-            "model_version": self.model_version,
+            # Per-request, not per-engine: a pool-model stream migrates
+            # under ITS model's tag+version, and the destination must
+            # resolve that tag on its own pool before importing.
+            "model_tag": req.model_tag,
+            "model_version": req.model_version,
             "src_engine": self.engine_id,
             "digest": req.digest.hexdigest(),
             "n_tokens": len(toks),
@@ -1996,11 +2271,32 @@ class Engine:
             raise EngineDraining(
                 f"engine is {self._health.value}; migrate to another replica"
             )
-        if snapshot.get("model_version") != self.model_version:
+        tag = snapshot.get("model_tag", DEFAULT_MODEL)
+        if tag == DEFAULT_MODEL:
+            local_version = self.model_version
+        else:
+            # A pool-model stream needs its model HERE, registered AND
+            # resident: an import must never stall mid-scatter on a
+            # weight load, and a missing model is a typed retryable
+            # incompatibility (the caller cold-replays or tries a peer).
+            if self.model_pool is None or tag not in self.model_pool:
+                raise MigrationIncompatible(
+                    f"stream is on model {tag!r} but this engine's pool "
+                    "does not register it; migrate to a replica that does"
+                )
+            dst_entry = self.model_pool._entries[tag]
+            if not dst_entry.ready:
+                raise MigrationIncompatible(
+                    f"model {tag!r} is registered here but not "
+                    "materialized; warm it (ModelPool.ensure) before "
+                    "importing its streams"
+                )
+            local_version = dst_entry.model_version
+        if snapshot.get("model_version") != local_version:
             raise MigrationIncompatible(
                 f"weights version mismatch: snapshot "
                 f"{snapshot.get('model_version')!r} != engine "
-                f"{self.model_version!r} — a cross-version migration "
+                f"{local_version!r} — a cross-version migration "
                 "would interleave two models in one stream"
             )
         if snapshot.get("block_size") != self.block_size:
@@ -2031,7 +2327,7 @@ class Engine:
         # Arrival verification (audit plane): the committed buffer must
         # still hash to the stream's digest before its KV is mapped in.
         if toks and not req.digest.matches_stream(
-            req.prompt, req.key, toks, self.model_version
+            req.prompt, req.key, toks, req.model_version
         ):
             _audit.record_divergence(
                 self,
@@ -2039,7 +2335,7 @@ class Engine:
                 where="migrate-in",
                 expected_digest=req.digest.hexdigest(),
                 replayed_digest=_audit.DeterminismDigest.of_stream(
-                    req.prompt, req.key, toks, self.model_version
+                    req.prompt, req.key, toks, req.model_version
                 ).hexdigest(),
                 n_tokens=len(toks),
             )
@@ -2164,7 +2460,25 @@ class Engine:
         n_total = blocks_needed(req.cache_tokens, self.block_size)
         shared: list = []
         cached_len = 0
-        if self.prefix is not None:
+        donor = (
+            self._fork_donors.get(req.fork_of)
+            if req.fork_of is not None and not req.handle._tokens
+            else None
+        )
+        if donor is not None:
+            # Fork sibling: map the parent's prompt-covering pages —
+            # ALL of them, the partial last page included (KV of an
+            # identical history is identical), which the prefix index
+            # could never offer (it names full pages only).  The
+            # sibling re-runs just the last prompt token to get its
+            # first-sample logits; that write copy-on-writes the last
+            # shared page first.  A sibling whose donor never appeared
+            # (parent failed before completing prefill) or that is
+            # replay-resuming falls through to the standard path.
+            self.allocator.share(donor)
+            shared = list(donor)
+            cached_len = len(req.prompt)
+        elif self.prefix is not None:
             if req.hashes is None:  # belt-and-braces: submit() hashed once
                 req.hashes = page_hashes(req.prompt, self.block_size)
             shared = self.prefix.match(req.hashes)
@@ -2180,7 +2494,7 @@ class Engine:
                 self.allocator.free(shared)
             self._pool_exhausted("serve.start_prefill", n_total - len(shared))
             raise RuntimeError("prefill could not reserve its promised pages")
-        if cached_len and not req.hit_counted:
+        if donor is None and cached_len and not req.hit_counted:
             # Counted once per REQUEST, not per admission attempt — a
             # transiently-failed prefill that requeues and re-admits
             # must not inflate the hit rate past 1.0.
@@ -2294,21 +2608,30 @@ class Engine:
             self._n_cow += 1
             _T_COW.add()
 
-    def _run_chunk(self, seq, table, start: int, end: int, key):
+    def _run_chunk(
+        self, seq, table, start: int, end: int, key,
+        model_tag: str = DEFAULT_MODEL,
+    ):
         """Dispatch ONE compiled prefill chunk of ``seq[start:end]``
-        against ``table``.  Returns the sampled first token on the final
-        chunk (``end == len(seq)``), else None."""
+        against ``table``, under ``model_tag``'s weights.  Returns the
+        sampled first token on the final chunk (``end == len(seq)``),
+        else None.  Pool-model chunks run the SAME two jitted programs
+        — a tag sharing the engine's family and cfg shares its
+        compiles; the observatory label carries the tag so per-model
+        compile attribution stays readable."""
         n = end - start
         bucket = self._chunk_bucket(n)
+        model, cfg, params = self._model_ctx(model_tag)
+        suffix = "" if model_tag == DEFAULT_MODEL else f":{model_tag}"
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = seq[start:end]
         pos = np.full((1,), start, np.int32)
         if end >= len(seq):
             first, self._cache = _JP_PREFILL_LAST.call(
-                self, f"prefill_chunk_last:b{bucket}",
-                self._params, self._cache, tokens, pos,
+                self, f"prefill_chunk_last:b{bucket}{suffix}",
+                params, self._cache, tokens, pos,
                 np.int32(end - 1 - start), key, table,
-                model=self.model, cfg=self.cfg,
+                model=model, cfg=cfg,
                 temperature=self.temperature, top_k=self.top_k,
             )
             tt = self._tick_timer
@@ -2323,9 +2646,9 @@ class Engine:
                 tt.begin("prefill_dispatch")
             return first
         self._cache = _JP_PREFILL.call(
-            self, f"prefill_chunk:b{bucket}",
-            self._params, self._cache, tokens, pos, table,
-            model=self.model, cfg=self.cfg,
+            self, f"prefill_chunk:b{bucket}{suffix}",
+            params, self._cache, tokens, pos, table,
+            model=model, cfg=cfg,
         )
         return None
 
@@ -2345,11 +2668,28 @@ class Engine:
             "serve.prefill", slot=slot, start=start, n=end - start,
             bucket=bucket, cached=req.n_cached,
         ):
-            return self._run_chunk(seq, req.table, start, end, req.key)
+            return self._run_chunk(
+                seq, req.table, start, end, req.key, req.model_tag
+            )
 
     def _complete_prefill(self, slot: int, req: Request, first: int) -> None:
         """Last chunk done: register the prompt's full pages in the
         prefix index and install the slot into the decode batch."""
+        if (
+            req.rid in self._fork_groups
+            and req.rid not in self._fork_donors
+        ):
+            # Fork parent: pin the prompt-covering pages for the
+            # siblings with ENGINE-held references — the donor survives
+            # the parent retiring (even on its very first token) and
+            # outlives the prefix index's full-page-only view.
+            n_prompt_pages = min(
+                blocks_needed(len(req.prompt), self.block_size),
+                len(req.blocks),
+            )
+            donor = [int(req.table[i]) for i in range(n_prompt_pages)]
+            self.allocator.share(donor)
+            self._fork_donors[req.rid] = donor
         if self.prefix is not None and req.hashes:
             self.prefix.register(
                 req.hashes,
@@ -2369,7 +2709,7 @@ class Engine:
             # re-hash, one compare): a corrupted buffer must fail
             # typed, never silently poison the continuation.
             if not req.digest.matches_stream(
-                req.prompt, req.key, toks, self.model_version
+                req.prompt, req.key, toks, req.model_version
             ):
                 self._resume_diverged(slot, req, "preempt-replay-resume")
                 return
@@ -2442,7 +2782,7 @@ class Engine:
             where=where,
             expected_digest=req.digest.hexdigest(),
             replayed_digest=_audit.DeterminismDigest.of_stream(
-                req.prompt, req.key, toks, self.model_version
+                req.prompt, req.key, toks, req.model_version
             ).hexdigest(),
             n_tokens=len(toks),
         )
@@ -2516,7 +2856,9 @@ class Engine:
             first = None
             for start in range(0, len(seq), self.prefill_chunk):
                 end = min(start + self.prefill_chunk, len(seq))
-                first = self._run_chunk(seq, table, start, end, req.key)
+                first = self._run_chunk(
+                    seq, table, start, end, req.key, req.model_tag
+                )
         except BaseException:
             self.allocator.free(blocks)
             req.blocks = None
@@ -2551,22 +2893,72 @@ class Engine:
         # auditor must catch (nothing else will: the device state keeps
         # the true token, so the stream stays plausible).
         corrupt = kind == "corrupt"
+        # Group the decode batch by model.  The common case is ONE
+        # group on the engine's own model and takes the exact
+        # pre-model-plane path: no array copies, one dispatch.  With
+        # pool models decoding, each group runs its own compiled chunk
+        # over a masked view of the slot arrays — non-group slots ride
+        # along as done-slots scribbling on the trash page (the same
+        # rule idle/prefilling/swapped slots already obey), so the
+        # sequential passes commute and donation stays safe (every
+        # pass returns a fresh pool).  Two tags sharing the engine's
+        # family and cfg share ONE compile: the jit cache keys on
+        # (module, cfg, shapes), not on the tag.
+        groups: dict[str, list] = {}
+        for slot, req in enumerate(self._slot_req):
+            if req is None or slot in self._prefill_q or slot in self._swapped:
+                continue
+            groups.setdefault(req.model_tag, []).append(slot)
+        committed = 0
+        for tag, slots in groups.items():
+            got = self._decode_group(
+                tag, slots, solo=len(groups) == 1, corrupt=corrupt
+            )
+            corrupt = False  # one flipped token per poisoned chunk
+            if got is None:  # dispatch failed; handled (retry/recovery)
+                break
+            committed += got
+        self._decode_tokens += committed
+        if self._decode_s > 0:
+            _G_DECODE_TPS.set(round(self._decode_tokens / self._decode_s, 1))
+        return committed
+
+    def _decode_group(
+        self, tag: str, slots: list, *, solo: bool, corrupt: bool
+    ) -> Optional[int]:
+        """One compiled decode chunk over the slots of ONE model.
+        ``solo`` (the whole decode batch is one model) passes the slot
+        arrays through unmasked — bit-identical to the single-model
+        engine.  Returns tokens committed, or None when the dispatch
+        failed and the failure was already handled (free retry next
+        tick, or the recovery supervisor ran)."""
+        model, cfg, params = self._model_ctx(tag)
+        if solo:
+            done, tables = self._done, self._tables
+        else:
+            # Masked copies: non-group slots read done=True and table 0
+            # (writes land on the trash page, outputs are discarded) —
+            # their REAL state stays untouched for their own pass.
+            done = np.ones_like(self._done)
+            done[slots] = self._done[slots]
+            tables = np.zeros_like(self._tables)
+            tables[slots] = self._tables[slots]
         tt = self._tick_timer
         if tt is not None:
             tt.begin("decode_dispatch")
-        sp = _telemetry.start_span(
-            "serve.step",
-            n_active=self._n_decoding(),
-            chunk=self.decode_chunk,
-        )
+        attrs = {"n_active": len(slots), "chunk": self.decode_chunk}
+        if tag != DEFAULT_MODEL:
+            attrs["model"] = tag
+        sp = _telemetry.start_span("serve.step", **attrs)
         t0 = time.perf_counter()
         try:
             self._cache, out = _JP_DECODE.call(
-                self, None,
-                self._params, self._cache,
-                self._tokens, self._positions, self._n_gen, self._done,
-                self._keys, self._tables,
-                model=self.model, cfg=self.cfg,
+                self,
+                None if tag == DEFAULT_MODEL else f"decode_chunk:{tag}",
+                params, self._cache,
+                self._tokens, self._positions, self._n_gen, done,
+                self._keys, tables,
+                model=model, cfg=cfg,
                 temperature=self.temperature, top_k=self.top_k,
                 eos_id=self.eos_id, n_steps=self.decode_chunk,
             )
@@ -2587,13 +2979,13 @@ class Engine:
                 # retry — a deterministic error must not spin, so the
                 # second consecutive failure escalates below.
                 _T_STEP_RETRIES.add()
-                return 0
+                return None
             # The chunk held the donated cache (or keeps failing): the
             # supervisor rebuilds the pool and replays every live
             # request token-identically, under per-request budgets.
             self._consec_decode_failures = 0
             self._supervise_recovery(err)
-            return 0
+            return None
         if tt is not None:
             # The dispatch gap: everything after here until the asarray
             # returns is the host blocked on device compute — the
@@ -2602,30 +2994,18 @@ class Engine:
         out = np.asarray(out)  # (chunk, S) — the one host sync per chunk
         if tt is not None:
             tt.begin("commit")
-        if corrupt:
+        if corrupt and slots:
             out = out.copy()  # the jax-backed view may be read-only
-            for slot in range(self.num_slots):
-                if (
-                    self._slot_req[slot] is not None
-                    and slot not in self._prefill_q
-                    and slot not in self._swapped
-                ):
-                    # Deterministic victim: the first decoding slot's
-                    # first token of this chunk, XOR 1.
-                    out[0, slot] = int(out[0, slot]) ^ 1
-                    _T_CORRUPTIONS.add()
-                    break
+            # Deterministic victim: the group's first decoding slot's
+            # first token of this chunk, XOR 1.
+            out[0, slots[0]] = int(out[0, slots[0]]) ^ 1
+            _T_CORRUPTIONS.add()
         self._consec_decode_failures = 0
         dt = time.perf_counter() - t0
         self._decode_s += dt
 
         committed = 0
-        for slot, req in enumerate(self._slot_req):
-            if req is None or slot in self._prefill_q or slot in self._swapped:
-                # Mid-prefill and swapped-out slots rode the batch as
-                # done-slots writing trash; they have no tokens to
-                # commit.
-                continue
+        for slot in slots:
             for tok in out[:, slot]:
                 self._push_token(slot, int(tok))
                 committed += 1
@@ -2638,14 +3018,13 @@ class Engine:
                 self._tokens[slot] = out[-1, slot]
                 self._positions[slot] += self.decode_chunk
                 self._n_gen[slot] += self.decode_chunk
-        self._decode_tokens += committed
         if committed:
             # Per-token decode time (TPOT): one aggregated observation
             # per chunk — each committed token cost one scan step of
             # this chunk's wall time.  No per-token call, no allocation.
             self._h_tpot.observe(dt / self.decode_chunk, n=committed)
-        if self._decode_s > 0:
-            _G_DECODE_TPS.set(round(self._decode_tokens / self._decode_s, 1))
+        if tag != DEFAULT_MODEL:
+            self.model_pool._note_tokens(tag, committed)
         sp.end(tokens=committed)
         return committed
 
@@ -2726,6 +3105,11 @@ class Engine:
         # account along with the ownership map.
         self._swapped.clear()
         self._swap_host_bytes = 0
+        # Fork donors died with the pool; drop the refs without frees
+        # (the allocator reset below reclaims every page).  The groups
+        # stay: a replaying parent re-creates its donor at prefill
+        # completion, so siblings still waiting in the queue re-share.
+        self._fork_donors.clear()
         if self.prefix is not None:
             self.prefix.clear()
         # Replay inputs verify against the determinism digest BEFORE
@@ -2736,7 +3120,7 @@ class Engine:
                 continue
             toks = req.handle._tokens
             if toks and not req.digest.matches_stream(
-                req.prompt, req.key, toks, self.model_version
+                req.prompt, req.key, toks, req.model_version
             ):
                 self._resume_diverged(slot, req, "recovery-replay")
         pending = [
@@ -2842,7 +3226,7 @@ class Engine:
         recoveries happened between chunks (resumes re-commit nothing)."""
         req = self._slot_req[slot]
         req.handle._push(token)
-        req.digest.update((token,), self.model_version)
+        req.digest.update((token,), req.model_version)
         self._emitted[slot] += 1
         _T_TOKENS.add()
         if self._emitted[slot] >= req.max_new_tokens or (
@@ -2918,6 +3302,11 @@ class Engine:
             out["audit_aborted"] = self._auditor.aborted
         if self._diverging:
             out["diverging"] = True
+        if self.model_pool is not None:
+            out["models"] = self.model_pool.stats()
+            out["forks"] = self._n_forks
+        elif self._n_forks:
+            out["forks"] = self._n_forks
         if self._decode_s > 0:
             out["decode_tokens_per_s"] = round(
                 self._decode_tokens / self._decode_s, 1

@@ -1,0 +1,565 @@
+"""Model plane: many models on one engine, one page pool.
+
+The paper's load-bearing feature — record a model's construction at
+near-zero cost, materialize on demand — applied to serving capacity
+instead of startup time.  An :class:`~.engine.Engine` is married to one
+*pool geometry* (layers × block_size × kv-heads × head-dim — what a KV
+page looks like), not to one set of weights: any model whose pages look
+the same can decode into the same pool.  The :class:`ModelPool` holds N
+such models over one engine:
+
+* **register** — a model enters as a *skeleton*: its parameter pytree as
+  shapes/dtypes only (:func:`jax.eval_shape` over the materialize
+  factory, or the family's ``abstract_params``), near-zero HBM, fully
+  inspectable geometry.  Registration validates pool-geometry
+  compatibility up front — an incompatible model is rejected at
+  register time, not at first traffic.
+* **materialize on demand** — the first ``submit(model=...)`` for a
+  cold model queues it; the engine materializes the weights *between*
+  decode ticks (one model per tick, after the decode dispatch), so a
+  cold model's materialize stall never blocks a hot model's decode.
+  The ``serve.materialize`` fault site fires per attempt; a transient
+  (``io``) failure leaves the skeleton untouched and retries next tick.
+* **evict under pressure** — materializing over ``hbm_budget_bytes``
+  (or ``max_resident``) first drops the least-recently-used *cold*
+  models' weights.  "Cold" is checked against live engine state — a
+  model with any slot (running, prefilling, or swapped out) is never
+  evicted; queued-only demand is safe to drop because admission
+  re-demands materialization.  The policy reads the HBM ledger's real
+  per-owner rows (:meth:`~torchdistx_tpu.telemetry.perf.Ledger.owners`:
+  ``weights`` vs ``kv_pool`` vs ``prefix_cache_held``), not estimates.
+  Eviction drops weights only; KV pages, streams, and the prefix index
+  are untouched.
+
+Determinism is per model: every registered model carries its own
+``model_version``, folded into every request digest
+(:class:`~torchdistx_tpu.telemetry.audit.DeterminismDigest`), so the
+same prompt under two models yields distinct digests and the shadow
+auditor can never cross-check.  The prefix index is model-namespaced
+the same way (:func:`~.prefix.page_hashes` seeds its chain with the
+model tag) — two models never share a KV page even for identical
+prompts.
+
+Telemetry: ``serve.models_resident{engine=}``,
+``serve.model_state{engine=,model=}`` (0 skeleton / 1 materialized),
+``serve.materializations`` / ``serve.model_evictions`` (global and
+``{engine=,model=}``-labeled), ``serve.materialize_s{engine=}`` (stall
+histogram), ``mem.hbm_bytes{component=weights}`` rows per model owner,
+and ``model.registered`` / ``model.materialized`` / ``model.evicted``
+lifecycle events under a ``serve.materialize`` span.  All per-engine
+families are pruned when the engine stops.  Full design:
+docs/serving.md, "Model plane".
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import telemetry as _telemetry
+from ..resilience import faults
+from ..telemetry import perf as _perf
+
+__all__ = ["DEFAULT_MODEL", "ModelPool"]
+
+# The engine's own construction-time model: always materialized, never
+# evictable, namespace b"" (single-model prefix hashes are unchanged).
+DEFAULT_MODEL = "default"
+
+_T_MATERIALIZATIONS = _telemetry.counter("serve.materializations")
+_T_EVICTIONS = _telemetry.counter("serve.model_evictions")
+_T_MODEL_STALLS = _telemetry.counter("serve.model_stalls")
+
+
+class _ModelEntry:
+    """One registered model: skeleton always, weights sometimes."""
+
+    __slots__ = (
+        "tag",
+        "model",
+        "cfg",
+        "model_version",
+        "materialize",
+        "skeleton",
+        "nbytes_estimate",
+        "params",
+        "params_nbytes",
+        "last_used",
+        "materializations",
+        "evictions",
+    )
+
+    def __init__(self, tag, model, cfg, model_version, materialize,
+                 skeleton, nbytes_estimate):
+        self.tag = tag
+        self.model = model
+        self.cfg = cfg
+        self.model_version = model_version
+        self.materialize = materialize
+        self.skeleton = skeleton
+        self.nbytes_estimate = nbytes_estimate
+        self.params = None  # prepped weights while materialized
+        self.params_nbytes = 0
+        self.last_used = 0  # LRU clock value of the latest demand
+        self.materializations = 0
+        self.evictions = 0
+
+    @property
+    def ready(self) -> bool:
+        return self.params is not None
+
+    @property
+    def namespace(self) -> bytes:
+        """Prefix-chain seed: pages are content-addressed per model."""
+        return self.tag.encode("utf-8")
+
+
+def _skeleton_nbytes(skeleton) -> int:
+    """Exact weight bytes from shapes/dtypes alone — the inspectable
+    half of deferred init: cost known before a byte is committed."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(skeleton):
+        total += int(math.prod(leaf.shape)) * leaf.dtype.itemsize
+    return total
+
+
+def _abstract_pool_geometry(model, cfg, block_size: int) -> tuple:
+    """What :func:`~.cache.pool_geometry` would say about a pool built
+    for ``model``/``cfg`` — from :func:`jax.eval_shape` only, no
+    allocation.  Must match the engine's live pool for the model to be
+    servable from it."""
+    import jax
+
+    proto = jax.eval_shape(lambda: model.init_cache(cfg, 1, 1))
+
+    def page(leaf):
+        n_layers, _, _, heads, head_dim = leaf.shape
+        return jax.ShapeDtypeStruct(
+            (n_layers, 1, block_size, heads, head_dim), leaf.dtype
+        )
+
+    abstract = jax.tree.map(page, proto)
+    leaves, treedef = jax.tree.flatten(abstract)
+    return (
+        str(treedef),
+        tuple(
+            (x.shape[0],) + tuple(x.shape[2:]) + (str(x.dtype),)
+            for x in leaves
+        ),
+    )
+
+
+class ModelPool:
+    """Deferred-init skeleton registry + weight residency manager for
+    one engine.
+
+    Construct, register models, then hand to
+    ``Engine(..., model_pool=pool)``; the engine binds the pool
+    (validating every registered skeleton against its live pool
+    geometry) and routes ``submit(model=tag)`` traffic through it.
+    ``register`` also works after binding — models can join a serving
+    engine at runtime, skeleton-first.
+
+    ``hbm_budget_bytes`` caps the ledger total (weights + kv_pool +
+    prefix_cache_held + everything else registered) the pool will
+    materialize into: crossing it evicts LRU cold models first.  The
+    budget is a pressure threshold, not a hard wall — if every other
+    model is pinned by live streams the demanded model still
+    materializes (serving beats strict accounting; the ledger records
+    the truth either way).  ``max_resident`` is the count-based
+    equivalent (N materialized pool models max); either, both, or
+    neither may be set.
+    """
+
+    def __init__(
+        self,
+        *,
+        hbm_budget_bytes: Optional[int] = None,
+        max_resident: Optional[int] = None,
+    ):
+        if hbm_budget_bytes is not None and hbm_budget_bytes <= 0:
+            raise ValueError("hbm_budget_bytes must be positive")
+        if max_resident is not None and max_resident < 1:
+            raise ValueError("max_resident must be >= 1")
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.max_resident = max_resident
+        self._entries: "OrderedDict[str, _ModelEntry]" = OrderedDict()
+        self._engine = None
+        self._clock = 0
+        self._materialize_no = 0  # serve.materialize fault-site attempts
+        self.materialize_retries = 0
+        # Per-engine labeled families (minted at bind, pruned at close):
+        self._g_resident = None
+        self._h_materialize = None
+        self._g_state: Dict[str, Any] = {}
+        self._c_requests: Dict[str, Any] = {}
+        self._c_tokens: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    # Registry
+
+    def register(
+        self,
+        tag: str,
+        *,
+        model,
+        cfg,
+        materialize: Callable[[], Any],
+        model_version: Optional[str] = None,
+        skeleton=None,
+    ) -> None:
+        """Admit a model as a skeleton — near-zero HBM until demanded.
+
+        ``materialize()`` must return the family parameter pytree for
+        ``model`` (e.g. ``lambda: llama.init_params(key, cfg)``, a
+        checkpoint load, or a deferred-init torch replay via
+        :func:`~torchdistx_tpu.fleet.hot_swap.materialize_standby`).
+        ``skeleton`` overrides the shape probe for factories
+        :func:`jax.eval_shape` cannot trace (torch tape replays); by
+        default the family's ``abstract_params(cfg)`` is used when
+        present, else the factory is shape-traced.  ``model_version``
+        defaults to the tag — it seeds every request digest, so two
+        registered models can never produce colliding digests.
+        """
+        import jax
+
+        if not tag or tag == DEFAULT_MODEL:
+            raise ValueError(
+                f"model tag must be non-empty and not {DEFAULT_MODEL!r} "
+                "(the engine's own model)"
+            )
+        if tag in self._entries:
+            raise ValueError(f"model {tag!r} already registered")
+        if skeleton is None:
+            abstract = getattr(model, "abstract_params", None)
+            skeleton = (
+                abstract(cfg) if abstract is not None
+                else jax.eval_shape(materialize)
+            )
+        entry = _ModelEntry(
+            tag=tag,
+            model=model,
+            cfg=cfg,
+            model_version=model_version if model_version is not None else tag,
+            materialize=materialize,
+            skeleton=skeleton,
+            nbytes_estimate=_skeleton_nbytes(skeleton),
+        )
+        if self._engine is not None:
+            self._check_geometry(entry)
+        self._entries[tag] = entry
+        if self._engine is not None:
+            self._mint_model_metrics(entry)
+        _telemetry.event(
+            "model.registered",
+            model=tag,
+            version=entry.model_version,
+            nbytes=entry.nbytes_estimate,
+            n_leaves=len(jax.tree.leaves(skeleton)),
+            engine=getattr(self._engine, "engine_id", None),
+        )
+
+    def tags(self) -> List[str]:
+        return list(self._entries)
+
+    def __contains__(self, tag: str) -> bool:
+        return tag in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def ready(self, tag: str) -> bool:
+        """True when ``tag``'s weights are resident (admissible now)."""
+        return self._entries[tag].ready
+
+    def geometry(self, tag: str) -> Dict[str, Any]:
+        """The skeleton's inspectable geometry — what deferred init
+        promises: full architecture knowledge at near-zero cost, before
+        (or instead of) paying for the weights."""
+        import jax
+
+        entry = self._entries[tag]
+        leaves = jax.tree.leaves(entry.skeleton)
+        return {
+            "tag": tag,
+            "version": entry.model_version,
+            "materialized": entry.ready,
+            "n_leaves": len(leaves),
+            "n_params": sum(int(math.prod(x.shape)) for x in leaves),
+            "nbytes": entry.nbytes_estimate,
+        }
+
+    # ------------------------------------------------------------------
+    # Engine binding
+
+    def _bind(self, engine) -> None:
+        """Called by ``Engine.__init__``: validate every skeleton
+        against the live pool geometry and mint the per-engine labeled
+        telemetry families."""
+        if self._engine is not None:
+            raise ValueError(
+                "ModelPool is already bound to an engine — one pool "
+                "serves one engine (its weights ledger rows and labeled "
+                "metric families are per-engine)"
+            )
+        self._engine = engine
+        for entry in self._entries.values():
+            self._check_geometry(entry)
+        eid = engine.engine_id
+        self._g_resident = _telemetry.gauge(
+            "serve.models_resident", engine=eid
+        )
+        self._g_resident.set(0)
+        self._h_materialize = _telemetry.histogram(
+            "serve.materialize_s", engine=eid
+        )
+        for entry in self._entries.values():
+            self._mint_model_metrics(entry)
+
+    def _check_geometry(self, entry: _ModelEntry) -> None:
+        from .cache import pool_geometry
+
+        eng = self._engine
+        want = pool_geometry(eng._cache)
+        got = _abstract_pool_geometry(
+            entry.model, entry.cfg, eng.block_size
+        )
+        if got != want:
+            raise ValueError(
+                f"model {entry.tag!r} cannot share engine "
+                f"{eng.engine_id}'s page pool: KV page geometry {got} "
+                f"!= pool geometry {want} (layers/heads/head-dim/dtype "
+                "must match; block_size already does by construction)"
+            )
+
+    def _mint_model_metrics(self, entry: _ModelEntry) -> None:
+        eid = self._engine.engine_id
+        tag = entry.tag
+        self._g_state[tag] = _telemetry.gauge(
+            "serve.model_state", engine=eid, model=tag
+        )
+        self._g_state[tag].set(1 if entry.ready else 0)
+        self._c_requests[tag] = _telemetry.counter(
+            "serve.model_requests", engine=eid, model=tag
+        )
+        self._c_tokens[tag] = _telemetry.counter(
+            "serve.model_tokens", engine=eid, model=tag
+        )
+
+    # ------------------------------------------------------------------
+    # Residency
+
+    def _touch(self, tag: str) -> _ModelEntry:
+        """Record demand (the LRU clock) and return the entry."""
+        entry = self._entries[tag]
+        self._clock += 1
+        entry.last_used = self._clock
+        return entry
+
+    def _note_request(self, tag: str) -> None:
+        c = self._c_requests.get(tag)
+        if c is not None:
+            c.add()
+
+    def _note_tokens(self, tag: str, n: int) -> None:
+        c = self._c_tokens.get(tag)
+        if c is not None and n:
+            c.add(n)
+
+    def _note_stall(self, tag: str) -> None:
+        """An admission tick held back by ``tag`` being cold."""
+        _T_MODEL_STALLS.add()
+
+    def resident(self) -> List[str]:
+        return [t for t, e in self._entries.items() if e.ready]
+
+    def _owner_key(self, tag: str) -> str:
+        return f"model:{self._engine.engine_id}:{tag}"
+
+    def ensure(self, tag: str):
+        """Materialize ``tag`` if cold (evicting under pressure first);
+        return its entry.  The engine calls this from its
+        materialize phase — after the tick's decode dispatch, one model
+        per tick — but it is also the public warm-up hook: call it
+        before opening traffic to take the stall off the first request.
+        """
+        import jax
+
+        if self._engine is None:
+            raise ValueError("ModelPool.ensure before binding an engine")
+        entry = self._touch(tag)
+        if entry.ready:
+            return entry
+        self._evict_for(entry)
+        self._materialize_no += 1
+        sp = _telemetry.start_span(
+            "serve.materialize", model=tag, engine=self._engine.engine_id
+        )
+        t0 = time.perf_counter()
+        try:
+            # The fault site fires INSIDE the span with nothing
+            # allocated and nothing registered: a kill here (the
+            # chaos drill's crash kind) leaves only the skeleton, so
+            # recovery re-enters exactly like a first demand.
+            kind = faults.fire("serve.materialize", self._materialize_no)
+            if kind is not None:  # nan/corrupt cooperation: attempt poisoned
+                raise faults.InjectedFault(
+                    f"injected {kind} fault at serve.materialize:"
+                    f"{self._materialize_no}"
+                )
+            params = entry.materialize()
+            prep = getattr(entry.model, "prep_decode", None)
+            if prep is not None:
+                params = prep(params, entry.cfg)
+            params = jax.block_until_ready(params)
+        except BaseException:
+            sp.cancel()
+            raise
+        stall_s = time.perf_counter() - t0
+        entry.params = params
+        entry.params_nbytes = _perf.pytree_nbytes(params)
+        entry.materializations += 1
+        _T_MATERIALIZATIONS.add()
+        _perf.ledger.register(
+            "weights", entry.params_nbytes, owner=self._owner_key(tag)
+        )
+        if self._h_materialize is not None:
+            self._h_materialize.observe(stall_s)
+        g = self._g_state.get(tag)
+        if g is not None:
+            g.set(1)
+        if self._g_resident is not None:
+            self._g_resident.set(len(self.resident()))
+        _telemetry.event(
+            "model.materialized",
+            model=tag,
+            version=entry.model_version,
+            nbytes=entry.params_nbytes,
+            stall_s=round(stall_s, 6),
+            engine=self._engine.engine_id,
+        )
+        sp.end(nbytes=entry.params_nbytes, stall_s=round(stall_s, 6))
+        return entry
+
+    def evict(self, tag: str) -> bool:
+        """Drop ``tag``'s weights back to the skeleton.  Refuses (False)
+        while any live stream — running, prefilling, or swapped-out
+        slot — is on the model; queued requests re-demand
+        materialization at admission, so they never pin weights."""
+        entry = self._entries[tag]
+        if not entry.ready:
+            return False
+        if self._engine is not None and self._engine._model_in_use(tag):
+            return False
+        nbytes = entry.params_nbytes
+        entry.params = None
+        entry.params_nbytes = 0
+        entry.evictions += 1
+        _T_EVICTIONS.add()
+        _perf.ledger.unregister("weights", owner=self._owner_key(tag))
+        g = self._g_state.get(tag)
+        if g is not None:
+            g.set(0)
+        if self._g_resident is not None:
+            self._g_resident.set(len(self.resident()))
+        _telemetry.event(
+            "model.evicted",
+            model=tag,
+            version=entry.model_version,
+            nbytes=nbytes,
+            engine=getattr(self._engine, "engine_id", None),
+        )
+        return True
+
+    def _evict_for(self, incoming: _ModelEntry) -> int:
+        """Make room for ``incoming`` under the residency knobs: evict
+        LRU cold models until under budget (or nothing cold remains).
+        Returns models evicted."""
+        evicted = 0
+        while True:
+            over = False
+            if self.max_resident is not None:
+                over = len(self.resident()) >= self.max_resident
+            if not over and self.hbm_budget_bytes is not None:
+                # Real ledger rows, per owner: this pool's weights plus
+                # everything else attributed on the device (the
+                # engine's kv_pool, its own weights, prefix pages,
+                # swap buffers) — pressure is against what is actually
+                # held, not against a private estimate.
+                held = sum(_perf.ledger.owners().values())
+                over = held + incoming.nbytes_estimate > self.hbm_budget_bytes
+            if not over:
+                return evicted
+            victim = None
+            for entry in self._entries.values():
+                if not entry.ready or entry is incoming:
+                    continue
+                if self._engine is not None and self._engine._model_in_use(
+                    entry.tag
+                ):
+                    continue
+                if victim is None or entry.last_used < victim.last_used:
+                    victim = entry
+            if victim is None:
+                # Everything resident is pinned by live streams: serve
+                # the demand anyway (the budget is pressure, not a
+                # wall) — the ledger keeps the overage honest.
+                return evicted
+            self.evict(victim.tag)
+            evicted += 1
+
+    # ------------------------------------------------------------------
+    # Teardown / introspection
+
+    def _close(self) -> None:
+        """Engine stop: drop every weight, unregister every ledger row,
+        prune every per-engine labeled family."""
+        for entry in self._entries.values():
+            if entry.ready:
+                entry.params = None
+                entry.params_nbytes = 0
+                _perf.ledger.unregister(
+                    "weights", owner=self._owner_key(entry.tag)
+                )
+        if self._engine is not None:
+            eid = self._engine.engine_id
+            _telemetry.remove("serve.models_resident", engine=eid)
+            for tag in self._entries:
+                _telemetry.remove("serve.model_state", engine=eid, model=tag)
+                _telemetry.remove(
+                    "serve.model_requests", engine=eid, model=tag
+                )
+                _telemetry.remove("serve.model_tokens", engine=eid, model=tag)
+        self._g_resident = None
+        self._h_materialize = None
+        self._g_state.clear()
+        self._c_requests.clear()
+        self._c_tokens.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "n_registered": len(self._entries),
+            "n_resident": len(self.resident()),
+            "materialize_retries": self.materialize_retries,
+            "models": {},
+        }
+        if self._h_materialize is not None and self._h_materialize.count:
+            out["materialize_p95_s"] = round(
+                self._h_materialize.percentile(95), 6
+            )
+        for tag, entry in self._entries.items():
+            out["models"][tag] = {
+                "materialized": entry.ready,
+                "version": entry.model_version,
+                "nbytes": (
+                    entry.params_nbytes if entry.ready
+                    else entry.nbytes_estimate
+                ),
+                "materializations": entry.materializations,
+                "evictions": entry.evictions,
+            }
+        return out

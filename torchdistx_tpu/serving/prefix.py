@@ -42,18 +42,27 @@ from .blocks import BlockAllocator
 __all__ = ["PrefixIndex", "page_hashes"]
 
 
-def page_hashes(tokens, block_size: int) -> List[bytes]:
+def page_hashes(
+    tokens, block_size: int, namespace: bytes = b""
+) -> List[bytes]:
     """Chained content hashes of every FULL page of ``tokens``.
 
     ``tokens`` is any int sequence; result ``i`` names the page holding
     ``tokens[i*bs:(i+1)*bs]`` *and* its entire history (the chain).  A
     trailing partial page gets no hash — its KV is still mutable.
+
+    ``namespace`` seeds the chain.  A page's KV is a function of the
+    tokens AND the model that computed it: on a multi-model engine
+    (:mod:`.modelpool`) the same prompt under two models must never
+    share pages, so the engine seeds the chain with the model tag and
+    the first hash already diverges.  The default (empty) namespace is
+    the engine's own model — single-model hashes are unchanged.
     """
     import numpy as np
 
     tok = np.ascontiguousarray(np.asarray(tokens, dtype=np.int32))
     out: List[bytes] = []
-    prev = b""
+    prev = namespace
     for i in range(len(tok) // block_size):
         h = hashlib.blake2b(digest_size=16)
         h.update(prev)

@@ -314,6 +314,8 @@ class QoSScheduler:
         allocator: BlockAllocator,
         block_size: int,
         reclaim: Optional[Callable[[int], int]] = None,
+        need: Optional[Callable[[Request], int]] = None,
+        ready: Optional[Callable[[Request], bool]] = None,
     ) -> List[Request]:
         """Pop up to ``max_prefills_per_tick`` requests in QoS order
         whose cumulative page reservations fit the free list.  Stops at
@@ -322,7 +324,15 @@ class QoSScheduler:
         same rule the FIFO scheduler enforces); the engine's preemption
         path is the legitimate way to make room for a blocked head.
         Backpressure accounting matches the FIFO scheduler's: any
-        stalled tick with work waiting counts, slot- or page-bound."""
+        stalled tick with work waiting counts, slot- or page-bound.
+
+        ``need``/``ready`` match the FIFO scheduler's contract (see
+        :meth:`.scheduler.FIFOScheduler.pop_admissible`): ``need(req)``
+        overrides the page reservation (fork siblings charge their true
+        marginal pages — the WFQ fare already charged their marginal
+        prefill, one chunk), ``ready(req)`` holds a cold-model head in
+        place without popping it while the engine materializes its
+        weights out-of-band."""
         out: List[Request] = []
         limit = min(self.max_prefills_per_tick, n_free_slots)
         if self._n and limit == 0:
@@ -331,15 +341,20 @@ class QoSScheduler:
         reserved = 0
         while self._n and len(out) < limit:
             head = self.peek()
-            need = blocks_needed(head.cache_tokens, block_size)
+            if ready is not None and not ready(head):
+                break  # cold model: the engine counts + materializes
+            n_pages = (
+                need(head) if need is not None
+                else blocks_needed(head.cache_tokens, block_size)
+            )
             avail = allocator.num_free - reserved
-            if need > avail and reclaim is not None:
-                reclaim(need - avail)
+            if n_pages > avail and reclaim is not None:
+                reclaim(n_pages - avail)
                 avail = allocator.num_free - reserved
-            if need > avail:
+            if n_pages > avail:
                 _T_BACKPRESSURE.add()
                 break
-            reserved += need
+            reserved += n_pages
             out.append(self._pop_next())
         self._set_gauges()
         return out

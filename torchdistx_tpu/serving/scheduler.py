@@ -72,6 +72,19 @@ class Request:
     # QoS context (inert under the FIFO scheduler; see qos.py):
     tenant: str = "default"  # fair-queueing share owner
     priority: int = 0  # priority class — higher admits (and preempts) first
+    # Model plane (see modelpool.py): which registered model serves this
+    # request, and the version tag folded into its determinism digest —
+    # per-model, so two models' digests of the same prompt can never
+    # collide (audit isolation for free).
+    model_tag: str = "default"
+    model_version: str = "v0"
+    # Parallel sampling (``submit(n=4)``): siblings share the parent's
+    # prompt pages and diverge copy-on-write.  ``fork_of`` is the parent
+    # rid (None for the parent itself / solo requests); ``fork_index``
+    # is this request's position in the group — its sampling key is
+    # ``fold_in(base_key, fork_index)``.
+    fork_of: Optional[int] = None
+    fork_index: int = 0
     # Trace context (see docs/observability.md, "Request tracing"): the
     # request-scoped id every req.* lifecycle event and serve.* span
     # carries.  A fleet submission pins one id across every failover hop
@@ -132,6 +145,10 @@ class RequestHandle:
         self._cancel_requested = False
         self.ttft_s: Optional[float] = None
         self.error: Optional[BaseException] = None
+        # Parallel sampling (``submit(n=4)``): every handle of the group
+        # carries the SAME list of all n sibling handles (index order);
+        # None for solo requests.
+        self.siblings: Optional[List["RequestHandle"]] = None
 
     @property
     def done(self) -> bool:
@@ -337,6 +354,8 @@ class FIFOScheduler:
         allocator: BlockAllocator,
         block_size: int,
         reclaim: Optional[Callable[[int], int]] = None,
+        need: Optional[Callable[[Request], int]] = None,
+        ready: Optional[Callable[[Request], bool]] = None,
     ) -> List[Request]:
         """Pop up to ``max_prefills_per_tick`` requests that fit the free
         slots AND whose cumulative page reservations fit the free list.
@@ -351,7 +370,18 @@ class FIFOScheduler:
         pages never cause an admission stall that an empty cache would
         not.  The reservation check is conservative (the head's FULL
         page quota, ignoring any prefix it may share): a cache hit can
-        only admit *no later* than a cache-off engine would."""
+        only admit *no later* than a cache-off engine would.
+
+        ``need(req)``, when given, overrides the reservation estimate —
+        the engine wires the model plane's fork accounting through it
+        (a sibling whose parent's prompt pages are live reserves only
+        its marginal pages).  ``ready(req)``, when given, gates the
+        head: a False head stalls admission WITHOUT being popped (a
+        cold model whose weights are still materializing — the engine
+        materializes out-of-band and the head admits next tick).  The
+        head-of-line rule is deliberate: skipping past a cold head
+        would reorder the FIFO, and the stall is one materialize long,
+        not a starvation risk."""
         out: List[Request] = []
         limit = min(self.max_prefills_per_tick, n_free_slots)
         if self._waiting and limit == 0:
@@ -359,15 +389,21 @@ class FIFOScheduler:
             return out
         reserved = 0
         while self._waiting and len(out) < limit:
-            need = blocks_needed(self._waiting[0].cache_tokens, block_size)
+            head = self._waiting[0]
+            if ready is not None and not ready(head):
+                break  # cold model: the engine counts + materializes
+            n_pages = (
+                need(head) if need is not None
+                else blocks_needed(head.cache_tokens, block_size)
+            )
             avail = allocator.num_free - reserved
-            if need > avail and reclaim is not None:
-                reclaim(need - avail)
+            if n_pages > avail and reclaim is not None:
+                reclaim(n_pages - avail)
                 avail = allocator.num_free - reserved
-            if need > avail:
+            if n_pages > avail:
                 _T_BACKPRESSURE.add()
                 break
-            reserved += need
+            reserved += n_pages
             out.append(self._waiting.popleft())
         self._set_queue_gauge(len(self._waiting))
         return out

@@ -235,6 +235,7 @@ class _AuditRecord:
 
     __slots__ = (
         "trace_id", "rid", "prompt", "key", "max_new", "digest", "tokens",
+        "model_tag",
     )
 
     def __init__(self, req, engine_id: str):
@@ -245,6 +246,10 @@ class _AuditRecord:
         self.max_new = req.max_new_tokens
         self.digest = req.digest.hexdigest()
         self.tokens = list(req.handle._tokens)
+        # Model-plane identity: a replay must run the SAME weights (the
+        # model_version folds into every token of the digest, so a
+        # wrong-model replay reads as a divergence, not a pass).
+        self.model_tag = getattr(req, "model_tag", "default")
 
 
 class ShadowAuditor:
@@ -341,6 +346,7 @@ class ShadowAuditor:
                 key=rec.key,
                 tenant="_audit",
                 priority=self.priority,
+                model=None if rec.model_tag == "default" else rec.model_tag,
                 _audit_of=rec.trace_id,
             )
         except Exception:  # noqa: BLE001 — overloaded/draining: retry later

@@ -485,6 +485,23 @@ class Ledger:
                 out[c] = out.get(c, 0) + v
         return out
 
+    def owners(self, component: Optional[str] = None) -> Dict[
+        Tuple[str, str], int
+    ]:
+        """``{(component, owner): bytes}`` — the per-owner attribution.
+
+        This is what the model plane's eviction policy reads
+        (docs/serving.md, "Model plane"): real registered numbers for
+        who holds what — ``weights`` per model, ``kv_pool`` per engine,
+        ``prefix_cache_held`` per engine — not estimates recomputed on
+        the side.  ``component`` filters to one component's owners."""
+        with self._lock:
+            return {
+                k: v
+                for k, v in self._entries.items()
+                if component is None or k[0] == component
+            }
+
     def total(self) -> int:
         with self._lock:
             return sum(self._entries.values())
