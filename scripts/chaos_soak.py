@@ -141,12 +141,29 @@ leaked pages / refcount drift; and the exported trace shows the
 ``serve.materialize`` span plus ``serve.materializations``,
 ``serve.model_evictions``, and ``serve.forks`` counters.
 
+**Crash-restart mode** (``python scripts/chaos_soak.py crashrestart``,
+ISSUE 20 acceptance gate): durability under a REAL ``kill -9``.  The
+soak re-invokes itself three times: a reference child runs the full
+seeded mixed wave (deadlines, cancels) uninterrupted and reports every
+stream's tokens + determinism digest; a journaled child runs the SAME
+wave (``Engine(journal=...)``, per-tick group commit) and is SIGKILLed
+by the parent mid-decode — no handlers, no flushes, owner lock left
+behind; a restart child steals the dead pid's stale lock via
+``resume_from_journal`` under **100% audit sampling** and finishes
+every stream.  Gates: **zero silently-lost requests** (every admitted
+uid retired in the final journal fold — finished, cancelled, or
+expired; never untyped), every stream finished in both runs
+**digest-identical** to the uninterrupted reference,
+``audit.divergences == 0``, and the restarted engine's allocator ends
+with zero leaked pages / zero refcount drift.
+
 CI (.github/workflows/ci.yaml, chaos-soak + fleet-chaos +
-autoscale-chaos + multimodel-chaos jobs) runs all modes with
-``TDX_TELEMETRY`` set.  Locally:
+autoscale-chaos + multimodel-chaos + crash-restart jobs) runs all
+modes with ``TDX_TELEMETRY`` set.  Locally:
 
     TDX_TELEMETRY=/tmp/chaos.jsonl JAX_PLATFORMS=cpu \\
-    python scripts/chaos_soak.py [fleet|migration|autoscale|multimodel]
+    python scripts/chaos_soak.py \\
+        [fleet|migration|autoscale|multimodel|crashrestart]
 """
 
 import json
@@ -2265,7 +2282,333 @@ def multimodel_main() -> int:
     return 0
 
 
+def _crashchild_main(phase: str, jdir: str) -> int:
+    """One crash-restart child (re-invoked ``chaos_soak.py _crashchild
+    <phase> <dir>``).  ``ref`` runs the wave uninterrupted (no journal)
+    and reports the oracle; ``crash`` runs it journaled, prints the
+    kill-window marker, and keeps stepping until the parent's SIGKILL
+    lands; ``resume`` steals the stale lock, finishes every stream at
+    100% audit sampling, and reports outcomes + the final journal fold.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu.serving import (
+        Engine,
+        RequestError,
+        RequestJournal,
+        journal as journal_mod,
+    )
+
+    from torchdistx_tpu.models import llama
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(SEED)
+
+    def make_engine(journal=None):
+        return Engine(
+            params, model=llama, cfg=cfg, eos_id=EOS, num_slots=4,
+            block_size=8, num_blocks=33, max_model_len=64, decode_chunk=4,
+            max_queue=4 * N_REQUESTS, drain_deadline_s=120.0,
+            handle_preemption=False, journal=journal,
+        )
+
+    def wave(eng):
+        """The seeded mixed wave — IDENTICAL across ref and crash
+        children (same SEED drives prompts, budgets, deadlines, and
+        cancels), so uid ``i+1`` means the same request in both."""
+        budgets = (4, 8, 12)
+        handles = []
+        for i in range(N_REQUESTS):
+            plen = int(rng.integers(3, 14))
+            prompt = rng.integers(0, cfg.vocab_size, size=plen).astype(
+                np.int32
+            )
+            mnt = int(rng.choice(budgets))
+            deadline = None if rng.random() > 0.05 else 1e-6
+            h = eng.submit(
+                prompt, max_new_tokens=mnt, key=i, deadline_s=deadline
+            )
+            if rng.random() < 0.05:
+                h.cancel()
+            handles.append(h)
+        return handles
+
+    def outcome(h):
+        if h.error is None:
+            return "finished"
+        if not isinstance(h.error, RequestError):
+            return f"UNTYPED:{type(h.error).__name__}"
+        return type(h.error).__name__
+
+    if phase == "ref":
+        eng = make_engine()
+        handles = wave(eng)
+        eng.drain()
+        out = {
+            str(i + 1): {
+                "outcome": outcome(h),
+                "digest": h.digest if h.error is None else None,
+            }
+            for i, h in enumerate(handles)
+        }
+        eng.close()
+        print("RESULT " + json.dumps({"streams": out}), flush=True)
+        return 0
+
+    if phase == "crash":
+        eng = make_engine(journal=RequestJournal(jdir))
+        handles = wave(eng)
+        for _ in range(6):  # mid-decode: slots full, streams partial
+            eng.step()
+        print("CRASH_WINDOW_OPEN", flush=True)
+        # Keep serving until the parent's SIGKILL lands — real work in
+        # flight, journal group-committing every tick, no cleanup runs.
+        for _ in range(MAX_STEPS):
+            eng.step()
+            time.sleep(0.01)
+        print("RESULT " + json.dumps({"error": "SIGKILL never arrived"}),
+              flush=True)
+        return 7
+
+    if phase == "resume":
+        eng = make_engine()  # audit sampling comes from TDX_AUDIT_SAMPLE
+        handles = eng.resume_from_journal(RequestJournal(jdir))
+        for _ in range(MAX_STEPS):
+            if not (
+                len(eng.scheduler) or eng._n_running()
+                or eng.audit_backlog()
+            ):
+                break
+            eng.step()
+        else:
+            print("RESULT " + json.dumps(
+                {"error": f"resume did not drain in {MAX_STEPS} steps"}
+            ), flush=True)
+            return 1
+        resumed = {
+            str(u): {
+                "outcome": outcome(h),
+                "digest": h.digest if h.error is None else None,
+            }
+            for u, h in handles.items()
+        }
+        st = eng.stats()
+        indexed = len(eng.prefix) if eng.prefix is not None else 0
+        leaked = eng.allocator.num_in_use - indexed
+        drift = (
+            eng.prefix.check(eng.allocator)
+            if eng.prefix is not None else None
+        )
+        eng.close()
+        entries, _ = journal_mod.fold_records(
+            journal_mod.read_records(jdir)
+        )
+        fold = {
+            str(u): {
+                "retired": e.retired,
+                "outcome": e.outcome,
+                "digest": e.digest,
+            }
+            for u, e in entries.items()
+        }
+        print("RESULT " + json.dumps({
+            "resumed": resumed,
+            "fold": fold,
+            "audit_checked": st.get("audit_checked", 0),
+            "audit_divergences": st.get("audit_divergences", 0),
+            "resumed_cold": st.get("journal", {}),
+            "leaked_pages": leaked,
+            "refcount_drift": drift,
+        }), flush=True)
+        return 0
+
+    print(f"chaos_soak: unknown crash child phase {phase!r}",
+          file=sys.stderr)
+    return 2
+
+
+def crashrestart_main() -> int:
+    """Crash-restart durability soak (ISSUE 20): real SIGKILL of a
+    loaded journaled engine subprocess, restart, resume at 100% audit
+    sampling — zero silent loss, digests equal the uninterrupted
+    reference, zero audit divergences, zero leaked pages."""
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    trace = os.environ.get("TDX_TELEMETRY", "")
+    if not trace:
+        print("chaos_soak: set TDX_TELEMETRY", file=sys.stderr)
+        return 2
+
+    jdir = os.path.join(tempfile.mkdtemp(prefix="tdx-crashrestart-"), "j")
+
+    def child_env(phase):
+        env = dict(os.environ)
+        env.pop("TDX_FAULT", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TDX_TELEMETRY"] = f"{trace}.{phase}"
+        env["TDX_AUDIT_SAMPLE"] = "1.0" if phase == "resume" else "0"
+        return env
+
+    def run_child(phase):
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "_crashchild",
+             phase, jdir],
+            env=child_env(phase), capture_output=True, text=True,
+            timeout=1800,
+        )
+
+    def result_of(stdout, stderr):
+        for line in stdout.splitlines():
+            if line.startswith("RESULT "):
+                return json.loads(line[len("RESULT "):])
+        print(f"chaos_soak: no RESULT line\nstdout:\n{stdout}\n"
+              f"stderr:\n{stderr}", file=sys.stderr)
+        return None
+
+    # ---- Reference: the uninterrupted oracle ----
+    proc = run_child("ref")
+    if proc.returncode != 0:
+        return fail(f"reference child rc={proc.returncode}: "
+                    f"{proc.stderr[-2000:]}")
+    ref = result_of(proc.stdout, proc.stderr)
+    if ref is None:
+        return 1
+    n_ref_finished = sum(
+        1 for s in ref["streams"].values() if s["outcome"] == "finished"
+    )
+    print(f"chaos_soak: crashrestart ref OK — {N_REQUESTS} streams, "
+          f"{n_ref_finished} finished (seed={SEED})")
+
+    # ---- The kill: a REAL SIGKILL on a loaded engine ----
+    # stderr goes to a file, not a pipe: an unread pipe fills and would
+    # block the child before it ever opens the kill window.
+    err_path = jdir + ".crash-stderr"
+    with open(err_path, "w") as err_f:
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "_crashchild",
+             "crash", jdir],
+            env=child_env("crash"), stdout=subprocess.PIPE,
+            stderr=err_f, text=True, bufsize=1,
+        )
+        killed = False
+        try:
+            for line in child.stdout:
+                if line.strip() == "CRASH_WINDOW_OPEN":
+                    child.kill()  # SIGKILL: no handlers, no cleanup
+                    killed = True
+                    break
+            child.wait(timeout=120)
+        finally:
+            if child.poll() is None:
+                child.kill()
+                child.wait()
+    if not killed:
+        with open(err_path) as f:
+            tail = f.read()[-2000:]
+        return fail("crash child never opened the kill window "
+                    f"(rc={child.returncode}): {tail}")
+    if child.returncode != -_signal.SIGKILL:
+        return fail(
+            f"crash child rc={child.returncode}, wanted "
+            f"-{int(_signal.SIGKILL)} (SIGKILL)"
+        )
+    print("chaos_soak: crashrestart kill OK — SIGKILL landed mid-decode, "
+          "journal unclosed, stale lock left")
+
+    # ---- Restart + resume at 100% audit sampling ----
+    proc = run_child("resume")
+    if proc.returncode != 0:
+        return fail(f"resume child rc={proc.returncode}: "
+                    f"{proc.stderr[-2000:]}")
+    res = result_of(proc.stdout, proc.stderr)
+    if res is None or res.get("error"):
+        return fail(f"resume child: {res}")
+
+    # Zero silently-lost requests: every admitted uid is in the final
+    # fold, retired, with a typed outcome — never "failed"/untyped.
+    all_uids = {str(i + 1) for i in range(N_REQUESTS)}
+    fold = res["fold"]
+    missing = all_uids - set(fold)
+    if missing:
+        return fail(f"{len(missing)} admitted streams absent from the "
+                    f"journal fold (lost): {sorted(missing)[:8]}")
+    unretired = [u for u in all_uids if not fold[u]["retired"]]
+    if unretired:
+        return fail(f"streams never retired after resume: {unretired[:8]}")
+    bad = {
+        u: fold[u]["outcome"] for u in all_uids
+        if fold[u]["outcome"] not in ("finished", "cancelled", "expired")
+    }
+    if bad:
+        return fail(f"streams retired with non-typed outcomes: {bad}")
+    for u, s in res["resumed"].items():
+        if s["outcome"].startswith("UNTYPED"):
+            return fail(f"resumed stream {u} failed untyped: {s['outcome']}")
+
+    # Digest identity: every stream finished in BOTH runs must carry the
+    # uninterrupted reference's exact determinism digest — whether it
+    # finished before the kill (fold digest) or after resume.
+    n_checked = n_resumed_finished = 0
+    for u, r in ref["streams"].items():
+        if r["outcome"] != "finished":
+            continue
+        if fold[u]["outcome"] != "finished":
+            return fail(
+                f"stream {u} finished uninterrupted but ended "
+                f"{fold[u]['outcome']!r} across the crash"
+            )
+        got = fold[u]["digest"]
+        if u in res["resumed"]:
+            got = res["resumed"][u]["digest"] or got
+            n_resumed_finished += 1
+        if got != r["digest"]:
+            return fail(f"stream {u} digest diverged across kill -9: "
+                        f"{got} != {r['digest']}")
+        n_checked += 1
+    if n_resumed_finished < 1:
+        return fail("the kill window closed after every stream finished "
+                    "— nothing was actually resumed")
+
+    # The restarted engine re-executed everything it served at 100%
+    # sampling: zero divergences, zero leaks, zero refcount drift.
+    if res["audit_checked"] < n_resumed_finished:
+        return fail(
+            f"audit checked {res['audit_checked']} < "
+            f"{n_resumed_finished} resumed streams at 100% sampling"
+        )
+    if res["audit_divergences"] != 0:
+        return fail(
+            f"audit.divergences = {res['audit_divergences']} != 0 on "
+            "the resumed engine"
+        )
+    if res["leaked_pages"] != 0:
+        return fail(f"resumed engine leaked {res['leaked_pages']} pages")
+    if res["refcount_drift"] is not None:
+        return fail(f"resumed engine refcount drift: "
+                    f"{res['refcount_drift']}")
+    jstats = res.get("resumed_cold") or {}
+    print(
+        "chaos_soak: crashrestart OK — "
+        f"{n_checked} digests identical across kill -9 "
+        f"({n_resumed_finished} finished post-resume), "
+        f"audit checked={res['audit_checked']} divergences=0, "
+        f"0 lost, 0 leaked (journal: {jstats.get('segments', '?')} "
+        f"segments, fsync={jstats.get('fsync', '?')})"
+    )
+    return 0
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "_crashchild":
+        sys.exit(_crashchild_main(sys.argv[2], sys.argv[3]))
+    if len(sys.argv) > 1 and sys.argv[1] == "crashrestart":
+        sys.exit(crashrestart_main())
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         sys.exit(fleet_main())
     if len(sys.argv) > 1 and sys.argv[1] == "migration":

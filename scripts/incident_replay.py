@@ -37,15 +37,29 @@ this replay could NOT reproduce, or a ``--with-faults`` reproduction
 failed; ``2`` — nothing replayable in the dump (no traced requests
 with replay identities, or no parsable records).
 
+The same loop closes over the durability plane's request journal
+(docs/resilience.md, "Durability"): ``--journal DIR`` folds the WAL
+segments (admits carry the replay identity, commits the committed
+tokens + rolling-digest snapshots), re-runs every journaled stream
+solo, and bisects any entry whose committed prefix disagrees with the
+deterministic ground truth — plus a WAL self-check that each entry's
+journaled digest snapshot matches the digest of its journaled tokens
+(a torn or corrupted journal fails here before any re-run would).
+
 Usage::
 
     python scripts/incident_replay.py /path/flight.jsonl
     python scripts/incident_replay.py flight.jsonl --with-faults --json out.json
+    python scripts/incident_replay.py --journal /path/journal-dir
     python scripts/incident_replay.py --drill        # CI: end-to-end
         # corrupt-fault incident drill — seeds a corrupt fault under
         # load at 100% audit sampling, asserts the auditor flight-dumps
         # the divergence, then replays its own dump and asserts the
         # bisection lands on the faulted chunk.
+    python scripts/incident_replay.py --journal-drill  # CI: journal
+        # forensics drill — journals a corrupt-fault run, then the
+        # --journal analysis must find exactly the corrupted stream and
+        # bisect to the same token/chunk the shadow auditor flagged.
 
 ``--model`` selects the weights: ``llama-test`` (the CI/chaos tiny
 llama, default) or ``module.path:factory`` returning
@@ -63,7 +77,7 @@ from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-__all__ = ["analyze", "load_dump", "main"]
+__all__ = ["analyze", "analyze_journal", "load_dump", "load_journal", "main"]
 
 
 # ---------------------------------------------------------------------------
@@ -359,7 +373,189 @@ def analyze(
 
 
 # ---------------------------------------------------------------------------
-# The CI drill
+# Journal forensics (--journal)
+
+
+def load_journal(dirpath: str):
+    """Fold a request-journal directory into ``(entries, config)`` —
+    ``entries`` maps uid → :class:`~torchdistx_tpu.serving.JournalEntry`
+    (torn tails tolerated, exactly as recovery reads them)."""
+    from torchdistx_tpu.serving import journal as journal_mod
+
+    records = list(journal_mod.read_records(dirpath))
+    entries, config = journal_mod.fold_records(records)
+    return entries, (config or {})
+
+
+def analyze_journal(
+    dirpath: str,
+    *,
+    model: str = "llama-test",
+    max_requests: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Verify a request journal against deterministic ground truth.
+
+    Two independent checks per journaled stream:
+
+    1. **WAL integrity** — the journaled rolling-digest snapshot must
+       equal the digest of the journaled tokens themselves (catches a
+       corrupted/hand-edited journal with no model run at all);
+    2. **Determinism** — a solo re-run of the entry's replay identity
+       must reproduce its committed prefix token-for-token; any
+       mismatch bisects to the exact token and decode chunk.
+    """
+    import numpy as np
+
+    from torchdistx_tpu.serving import RequestError
+    from torchdistx_tpu.telemetry import audit
+
+    entries, config = load_journal(dirpath)
+    decode_chunk = int(config.get("decode_chunk", 8) or 8)
+    todo = [entries[u] for u in sorted(entries)]
+    if max_requests is not None:
+        todo = todo[:max_requests]
+    result: Dict[str, Any] = {
+        "mode": "journal",
+        "journal_dir": dirpath,
+        "n_entries": len(entries),
+        "n_unretired": sum(1 for e in entries.values() if not e.retired),
+        "engine_config": config,
+        "entries": [],
+        "divergences": [],
+        "digest_inconsistencies": [],
+        "reproduced": False,
+    }
+    if not todo:
+        result["error"] = f"nothing replayable: no journal entries in {dirpath}"
+        return result
+
+    params, model_mod, cfg = resolve_model(model)
+    eng = _build_engine(config, params, model_mod, cfg, audit_sample=0.0)
+    try:
+        for e in todo:
+            row: Dict[str, Any] = {
+                "uid": e.uid,
+                "n_committed": len(e.tokens),
+                "retired": e.retired,
+                "outcome": e.outcome,
+            }
+            committed = [int(t) for t in e.tokens]
+            if e.digest is not None:
+                dig = audit.DeterminismDigest(
+                    np.asarray(e.prompt, np.int32),
+                    np.asarray(e.key, np.uint32),
+                )
+                dig.update(committed, e.model_version)
+                row["digest_consistent"] = dig.hexdigest() == e.digest
+                if not row["digest_consistent"]:
+                    result["digest_inconsistencies"].append(dict(row))
+            try:
+                h = eng.submit(
+                    np.asarray(e.prompt, np.int32),
+                    max_new_tokens=int(e.max_new_tokens),
+                    key=np.asarray(e.key, np.uint32),
+                )
+                toks = h.result()
+            except (RequestError, ValueError) as err:
+                row["error"] = f"{type(err).__name__}: {err}"
+                result["entries"].append(row)
+                continue
+            row["n_rerun"] = len(toks)
+            if toks[: len(committed)] != committed:
+                idx = audit.first_divergence(committed, toks)
+                row["first_diverging_token"] = idx
+                row["first_diverging_chunk"] = audit.token_chunk(
+                    idx, decode_chunk
+                )
+                row["journaled_token"] = (
+                    committed[idx] if idx < len(committed) else None
+                )
+                row["true_token"] = (
+                    int(toks[idx]) if idx < len(toks) else None
+                )
+                result["divergences"].append(dict(row))
+            result["entries"].append(row)
+    finally:
+        eng.close()
+    result["reproduced"] = (
+        not result["divergences"] and not result["digest_inconsistencies"]
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# The CI drills
+
+
+def journal_drill() -> int:
+    """End-to-end journal forensics drill: a journaled run with a seeded
+    ``corrupt`` fault must leave a WAL whose ``--journal`` analysis
+    finds exactly the corrupted stream — bisected to the same token and
+    chunk the shadow auditor (100% sampling) flagged live."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.resilience import faults as faults_mod
+    from torchdistx_tpu.serving import Engine, RequestJournal
+
+    params, model_mod, cfg = _model_llama_test()
+    jdir = os.path.join(tempfile.mkdtemp(prefix="tdx-jdrill-"), "journal")
+    fault_chunk = 6
+    faults_mod.reset(f"serve.step:{fault_chunk}:corrupt")
+    rng = np.random.default_rng(7)
+    try:
+        eng = Engine(
+            params, model=model_mod, cfg=cfg, num_slots=4, block_size=8,
+            num_blocks=41, max_model_len=64, decode_chunk=4,
+            max_prefills_per_tick=4,
+            handle_preemption=False, audit_sample=1.0,
+            journal=RequestJournal(jdir),
+        )
+        handles = [
+            eng.submit(
+                rng.integers(0, cfg.vocab_size, size=6).astype(np.int32),
+                max_new_tokens=24,
+                key=i,
+            )
+            for i in range(4)
+        ]
+        eng.drain()
+        for h in handles:
+            assert h.error is None, f"drill request failed: {h.error!r}"
+        st = eng.stats()
+        assert st["audit_divergences"] == 1, (
+            "the auditor must flag EXACTLY the corrupted stream, "
+            f"got {st['audit_divergences']}"
+        )
+        detail = eng._auditor.divergence_detail[0]
+        eng.close()
+        faults_mod.reset("")
+
+        result = analyze_journal(jdir)
+        assert result["n_entries"] == 4, result
+        assert not result["digest_inconsistencies"], (
+            "the WAL itself must be internally consistent — it recorded "
+            f"the corrupted stream faithfully: {result}"
+        )
+        assert len(result["divergences"]) == 1, result
+        row = result["divergences"][0]
+        # Independent cross-check: the live auditor's bisection and the
+        # post-hoc journal analysis must land on the same token/chunk.
+        assert row["first_diverging_token"] == detail["first_diverging_token"]
+        assert row["first_diverging_chunk"] == detail["first_diverging_chunk"]
+        print(
+            "incident_replay journal drill OK — corrupt fault journaled, "
+            f"WAL self-check passed on all {result['n_entries']} entries, "
+            f"analysis bisected entry uid={row['uid']} to token "
+            f"{row['first_diverging_token']} chunk "
+            f"{row['first_diverging_chunk']} (matches the live auditor)"
+        )
+        return 0
+    finally:
+        faults_mod.reset("")
 
 
 def drill() -> int:
@@ -454,6 +650,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("dump", nargs="?", help="flight-dump JSONL to replay")
     ap.add_argument(
+        "--journal", metavar="DIR",
+        help="analyze a request-journal directory instead of a flight "
+        "dump: WAL self-check + solo re-run of every journaled stream",
+    )
+    ap.add_argument(
         "--model", default="llama-test",
         help="weights source: 'llama-test' or module.path:factory "
         "returning (params, model_module, cfg)",
@@ -473,28 +674,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run the self-contained corrupt-fault incident drill "
         "(CI acceptance gate); ignores the other arguments",
     )
+    ap.add_argument(
+        "--journal-drill", action="store_true",
+        help="run the self-contained journal forensics drill "
+        "(CI acceptance gate); ignores the other arguments",
+    )
     args = ap.parse_args(argv)
 
     if args.drill:
         return drill()
-    if not args.dump:
-        ap.error("a dump path (or --drill) is required")
+    if args.journal_drill:
+        return journal_drill()
+    if not args.dump and not args.journal:
+        ap.error("a dump path, --journal DIR, or --drill is required")
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-    records = load_dump(args.dump)
-    if not records:
-        print(f"incident_replay: no parsable records in {args.dump}",
-              file=sys.stderr)
-        return 2
-    result = analyze(
-        records,
-        model=args.model,
-        with_faults=args.with_faults,
-        max_requests=args.max_requests,
-    )
+    if args.journal:
+        result = analyze_journal(
+            args.journal,
+            model=args.model,
+            max_requests=args.max_requests,
+        )
+    else:
+        records = load_dump(args.dump)
+        if not records:
+            print(f"incident_replay: no parsable records in {args.dump}",
+                  file=sys.stderr)
+            return 2
+        result = analyze(
+            records,
+            model=args.model,
+            with_faults=args.with_faults,
+            max_requests=args.max_requests,
+        )
     out = json.dumps(result, indent=2, sort_keys=True, default=str)
     print(out)
     if args.json:

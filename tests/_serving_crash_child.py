@@ -1,0 +1,113 @@
+"""Serving crash child for the journal kill -9 e2e (test_journal.py).
+
+Three modes, one per subprocess (``argv = mode journal_dir temperature``):
+
+* ``ref``    — uninterrupted run, no journal: the deterministic ground
+  truth (tokens + digests per request, keyed by the uid the journaled
+  run will assign in the same submit order).
+* ``crash``  — the same requests against a journaled engine with
+  ``TDX_FAULT=serve.step:N:crash`` armed in the environment: the
+  process dies ``os._exit(CRASH_EXIT_CODE)`` mid-decode — no finally
+  blocks, no atexit, journal unclosed, owner lock left behind.
+* ``resume`` — a fresh process: build a bare engine, steal the dead
+  pid's stale lock via ``resume_from_journal``, finish every resumed
+  stream, and report tokens/digests plus the journal's folded view of
+  streams that had already finished before the crash.
+
+Results print as one ``RESULT {json}`` line (the test_crash_resume
+protocol).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_REQ = 4
+MAX_NEW = 24
+
+
+def _build(temperature, journal=None):
+    import jax
+
+    from torchdistx_tpu.models import llama
+    from torchdistx_tpu.serving import Engine
+
+    cfg = llama.llama_test()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = Engine(
+        params, model=llama, cfg=cfg, num_slots=4, block_size=8,
+        num_blocks=41, max_model_len=64, decode_chunk=4,
+        max_prefills_per_tick=4, handle_preemption=False,
+        temperature=temperature, top_k=8 if temperature else None,
+        journal=journal,
+    )
+    return eng, cfg
+
+
+def _prompts(cfg):
+    rng = np.random.default_rng(11)
+    return [
+        rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+        for _ in range(N_REQ)
+    ]
+
+
+def main() -> int:
+    mode, jdir, temperature = sys.argv[1], sys.argv[2], float(sys.argv[3])
+    from torchdistx_tpu.serving import RequestJournal
+
+    if mode == "ref":
+        eng, cfg = _build(temperature)
+        toks, digs = {}, {}
+        for i, p in enumerate(_prompts(cfg)):
+            h = eng.submit(p, max_new_tokens=MAX_NEW, key=i)
+            # uid i+1: the journaled run admits in the same order.
+            toks[str(i + 1)] = h.result()
+            digs[str(i + 1)] = h.digest
+        eng.close()
+        print("RESULT " + json.dumps({"tokens": toks, "digests": digs}))
+        return 0
+
+    if mode == "crash":
+        eng, cfg = _build(temperature, journal=RequestJournal(jdir))
+        hs = [
+            eng.submit(p, max_new_tokens=MAX_NEW, key=i)
+            for i, p in enumerate(_prompts(cfg))
+        ]
+        for h in hs:  # drives the engine until the crash fault fires
+            h.result()
+        print("RESULT " + json.dumps({"error": "crash fault never fired"}))
+        return 1
+
+    if mode == "resume":
+        from torchdistx_tpu.serving import journal as journal_mod
+
+        eng, cfg = _build(temperature)
+        handles = eng.resume_from_journal(RequestJournal(jdir))
+        toks = {str(u): h.result() for u, h in sorted(handles.items())}
+        digs = {str(u): h.digest for u, h in sorted(handles.items())}
+        stats = eng.stats()
+        eng.close()
+        entries, _ = journal_mod.fold_records(journal_mod.read_records(jdir))
+        finished = {
+            str(u): e.tokens
+            for u, e in entries.items()
+            if e.retired and e.outcome == "finished"
+        }
+        print("RESULT " + json.dumps({
+            "resumed": toks,
+            "digests": digs,
+            "finished": finished,
+            "journal": stats.get("journal"),
+        }))
+        return 0
+
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,460 @@
+"""Durability plane: crash-consistent request journal + cold-restart resume.
+
+The acceptance bar (ISSUE 20): the WAL is torn-tail tolerant (a
+truncated final record is skipped, never misparsed — pinned at EVERY
+byte offset); an fsync io failure degrades the journal to async with a
+counter and never blocks the tick; two engines offered one journal
+resolve to exactly one winner (the loser gets typed ``JournalOwned``,
+a stale dead-pid lock is stolen silently); rotation compacts retired
+requests away while preserving live streams byte-exactly; and a
+``kill -9``'d engine's in-flight streams finish **token-identically**
+(and digest-identically) in a restarted process, greedy and sampled —
+the subprocess e2e at the bottom is the serving twin of
+``test_crash_resume.py``.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from torchdistx_tpu import telemetry
+from torchdistx_tpu.models import llama
+from torchdistx_tpu.resilience import CRASH_EXIT_CODE, faults, preemption
+from torchdistx_tpu.serving import (
+    DeadlineExceeded,
+    Engine,
+    JournalOwned,
+    ModelPool,
+    RequestJournal,
+)
+from torchdistx_tpu.serving import journal as journal_mod
+from torchdistx_tpu.serving.journal import (
+    fold_records,
+    read_records,
+    read_segment,
+)
+
+CHILD = os.path.join(os.path.dirname(__file__), "_serving_crash_child.py")
+
+ENGINE_KW = dict(
+    num_slots=4, block_size=8, num_blocks=41, max_model_len=64,
+    decode_chunk=4, max_prefills_per_tick=4, handle_preemption=False,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    preemption.clear()
+    faults.reset("")
+    yield
+    preemption.clear()
+    faults.reset("")
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return llama.llama_test()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _prompts(cfg, n=3, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, cfg.vocab_size, size=6).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def _simulate_kill9(eng):
+    """In-process stand-in for a hard kill: the engine forgets its
+    journal without closing it (no final sync, no retirements), and the
+    lock is dropped as a dead process's would effectively be (in-process
+    the pid is alive, so a stale-steal can't stand in)."""
+    j = eng._journal
+    eng._journal = None
+    j.release()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# WAL mechanics (no engine, no model)
+
+
+def test_wal_roundtrip_and_fold(tmp_path):
+    d = str(tmp_path / "j")
+    j = RequestJournal(d, fsync="always")
+    j.claim("eng-a")
+    j.write_config(engine="eng-a", decode_chunk=4, model_version="v0")
+    j.append({"t": "admit", "u": 1, "prompt": [1, 2], "key": [0, 7],
+              "max_new": 8, "model": "default", "version": "v0"})
+    j.append({"t": "commit", "u": 1, "toks": [5, 6], "n": 2, "d": "ab"})
+    j.append({"t": "admit", "u": 2, "prompt": [3], "key": [0, 1],
+              "max_new": 4})
+    j.append({"t": "retire", "u": 2, "outcome": "cancelled"})
+    assert j.stats()["live"] == 1
+    j.close()
+
+    entries, config = fold_records(read_records(d))
+    assert config["engine"] == "eng-a"
+    e1 = entries[1]
+    assert e1.prompt == [1, 2] and e1.key == [0, 7]
+    assert e1.tokens == [5, 6] and e1.digest == "ab"
+    assert not e1.retired and e1.n_gen == 2
+    assert entries[2].retired and entries[2].outcome == "cancelled"
+
+    # A re-claim appends a fresh config record; the LAST one governs
+    # (the newest engine's geometry), and uids keep ascending.
+    j2 = RequestJournal(d)
+    unfinished, _ = j2.recover()
+    assert set(unfinished) == {1}
+    assert j2.next_uid() == 3
+    j2.claim("eng-b")
+    j2.write_config(engine="eng-b", decode_chunk=4)
+    j2.close()
+    _, config = fold_records(read_records(d))
+    assert config["engine"] == "eng-b"
+    assert RequestJournal(d).peek_config()["engine"] == "eng-b"
+
+
+def test_torn_tail_at_every_byte_offset(tmp_path):
+    """Truncating the segment at ANY byte offset parses cleanly to the
+    intact prefix — short header, short payload, and mid-record cuts
+    are all 'torn tail', never a misparse, never an exception."""
+    d = str(tmp_path / "j")
+    j = RequestJournal(d, fsync="always")
+    j.claim("eng")
+    recs = [
+        {"t": "admit", "u": i, "prompt": [i] * 4, "key": [0, i],
+         "max_new": 8}
+        for i in range(1, 5)
+    ]
+    for r in recs:
+        j.append(r)
+    j.close()
+    seg = journal_mod._segments(d)[0]
+    with open(seg, "rb") as f:
+        data = f.read()
+    # Frame boundaries from the on-disk layout itself.
+    bounds, off = [0], 0
+    while off < len(data):
+        (length,) = struct.unpack_from("<I", data, off)
+        off += 8 + length
+        bounds.append(off)
+    assert bounds[-1] == len(data) and len(bounds) == len(recs) + 1
+
+    scratch = str(tmp_path / "trunc.wal")
+    for cut in range(len(data) + 1):
+        with open(scratch, "wb") as f:
+            f.write(data[:cut])
+        got, torn = read_segment(scratch)
+        n_intact = sum(1 for b in bounds[1:] if b <= cut)
+        assert [r["u"] for r in got] == [r["u"] for r in recs[:n_intact]]
+        assert torn == (cut not in bounds)
+
+
+def test_corrupt_byte_stops_reader_cleanly(tmp_path):
+    """A flipped byte mid-record fails the CRC: the reader returns the
+    intact prefix and flags the segment — it never yields garbage."""
+    d = str(tmp_path / "j")
+    j = RequestJournal(d, fsync="always")
+    j.claim("eng")
+    for i in range(1, 4):
+        j.append({"t": "admit", "u": i, "prompt": [i], "key": [0, i],
+                  "max_new": 8})
+    j.close()
+    seg = journal_mod._segments(d)[0]
+    with open(seg, "rb") as f:
+        data = bytearray(f.read())
+    # Flip a payload byte inside the SECOND record.
+    (len0,) = struct.unpack_from("<I", data, 0)
+    data[8 + len0 + 8 + 2] ^= 0xFF
+    with open(seg, "wb") as f:
+        f.write(bytes(data))
+    got, torn = read_segment(seg)
+    assert [r["u"] for r in got] == [1]
+    assert torn
+
+
+def test_fsync_io_fault_degrades_to_async(tmp_path):
+    """TDX_FAULT journal.fsync:N:io — the group commit degrades the
+    journal to async with a counter; appends keep landing and nothing
+    raises into the tick."""
+    d = str(tmp_path / "j")
+    j = RequestJournal(d, fsync="tick")
+    j.claim("eng")
+    degraded = telemetry.counter("journal.fsync_degraded")
+    before = degraded.value
+    j.append({"t": "admit", "u": 1, "prompt": [1], "key": [0, 0],
+              "max_new": 2})
+    faults.reset("journal.fsync:1:io")
+    j.sync()
+    assert j.degraded
+    assert degraded.value == before + 1
+    assert j.stats()["degraded"]
+    # Still appending, still readable, no further fsync attempts.
+    j.append({"t": "commit", "u": 1, "toks": [9], "n": 1, "d": "cc"})
+    j.sync()
+    j.close()
+    entries, _ = fold_records(read_records(d))
+    assert entries[1].tokens == [9]
+
+
+def test_double_claim_typed_refusal_and_stale_steal(tmp_path):
+    d = str(tmp_path / "j")
+    j1 = RequestJournal(d)
+    j1.claim("eng-a")
+    with pytest.raises(JournalOwned):
+        RequestJournal(d).claim("eng-b")
+    j1.close()  # releases the lock
+    j2 = RequestJournal(d)
+    j2.claim("eng-b")
+    j2.close()
+    # A dead pid's lock is stale: stolen silently, never JournalOwned.
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    with open(os.path.join(d, journal_mod._LOCK_NAME), "w") as f:
+        json.dump({"owner": "ghost", "pid": p.pid}, f)
+    j3 = RequestJournal(d)
+    j3.claim("eng-c")
+    j3.close()
+
+
+def test_rotation_compacts_retired_keeps_live(tmp_path):
+    d = str(tmp_path / "j")
+    j = RequestJournal(d, fsync="async", rotate_bytes=4096)
+    j.claim("eng")
+    j.write_config(engine="eng", decode_chunk=4)
+    j.append({"t": "admit", "u": 1, "prompt": [1, 2, 3], "key": [0, 1],
+              "max_new": 64})
+    j.append({"t": "commit", "u": 1, "toks": [7, 8], "n": 2, "d": "aa"})
+    u = 2
+    while j.stats()["segments_compacted"] == 0:
+        j.append({"t": "admit", "u": u, "prompt": [0] * 30, "key": [0, u],
+                  "max_new": 8})
+        j.append({"t": "retire", "u": u, "outcome": "finished", "n": 0})
+        u += 1
+        assert u < 500, "rotation never triggered"
+    j.close()
+    # One active segment on disk, the config carried over, the live
+    # stream checkpointed byte-exactly, the retired churn gone.
+    assert len(journal_mod._segments(d)) == 1
+    entries, config = fold_records(read_records(d))
+    assert config is not None and config["engine"] == "eng"
+    live = {uu for uu, e in entries.items() if not e.retired}
+    assert live == {1}
+    assert entries[1].tokens == [7, 8] and entries[1].digest == "aa"
+    assert len(entries) <= 2  # live + at most the post-rotation straggler
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: in-process resume
+
+
+def test_resume_in_process_token_identical(tmp_path, cfg, params):
+    """Crash-sim partway through decode; a fresh engine resumes every
+    stream from the journal and finishes token-identically (the
+    fold_in(key, n_gen) schedule continues where the commit left off)."""
+    ps = _prompts(cfg)
+    eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    ref = [
+        eng.submit(p, max_new_tokens=24, key=i).result()
+        for i, p in enumerate(ps)
+    ]
+    eng.close()
+
+    d = str(tmp_path / "j")
+    eng1 = Engine(params, model=llama, cfg=cfg,
+                  journal=RequestJournal(d), **ENGINE_KW)
+    hs = [eng1.submit(p, max_new_tokens=24, key=i)
+          for i, p in enumerate(ps)]
+    for _ in range(3):
+        eng1.step()
+    assert all(0 < len(h._tokens) < 24 for h in hs), "crash-sim too late"
+    _simulate_kill9(eng1)
+
+    resumed = telemetry.counter("journal.resumed")
+    before = resumed.value
+    eng2 = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    handles = eng2.resume_from_journal(RequestJournal(d))
+    assert sorted(handles) == [1, 2, 3]
+    got = [handles[u].result() for u in sorted(handles)]
+    assert eng2.stats()["journal"]["live"] == 0
+    eng2.close()
+    assert got == ref
+    assert resumed.value == before + 3
+
+
+def test_geometry_mismatch_refused_before_claim(tmp_path, cfg, params):
+    """A journal recorded at one sampling geometry refuses an engine at
+    another (ValueError, lock untouched) — so a fleet recover() can
+    skip to a compatible replica."""
+    d = str(tmp_path / "j")
+    eng1 = Engine(params, model=llama, cfg=cfg,
+                  journal=RequestJournal(d), **ENGINE_KW)
+    h = eng1.submit(_prompts(cfg)[0], max_new_tokens=24, key=0)
+    for _ in range(3):
+        eng1.step()
+    _simulate_kill9(eng1)
+
+    kw = dict(ENGINE_KW, decode_chunk=8)  # different geometry
+    eng2 = Engine(params, model=llama, cfg=cfg, **kw)
+    with pytest.raises(ValueError, match="journal"):
+        eng2.resume_from_journal(RequestJournal(d))
+    eng2.close()
+    # The refusal did NOT consume the lock: a matching engine resumes.
+    eng3 = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    handles = eng3.resume_from_journal(RequestJournal(d))
+    assert sorted(handles) == [1]
+    handles[1].result()
+    eng3.close()
+
+
+def test_double_resume_exactly_one_winner(tmp_path, cfg, params):
+    """Two live engines offered one journal: the first resume claims
+    ownership; the second gets typed JournalOwned, resumes nothing."""
+    d = str(tmp_path / "j")
+    eng1 = Engine(params, model=llama, cfg=cfg,
+                  journal=RequestJournal(d), **ENGINE_KW)
+    eng1.submit(_prompts(cfg)[0], max_new_tokens=24, key=0)
+    for _ in range(3):
+        eng1.step()
+    _simulate_kill9(eng1)
+
+    winner = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    handles = winner.resume_from_journal(RequestJournal(d))
+    assert sorted(handles) == [1]
+    loser = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    with pytest.raises(JournalOwned):
+        loser.resume_from_journal(RequestJournal(d))
+    loser.close()
+    handles[1].result()
+    winner.close()
+
+
+def test_resume_expired_deadline_fails_typed(tmp_path, cfg, params):
+    """A journaled stream whose wall-clock deadline passed during the
+    outage fails typed DeadlineExceeded at resume — never silently
+    generated past its SLO."""
+    d = str(tmp_path / "j")
+    j = RequestJournal(d, fsync="always")
+    j.claim("dead-engine")
+    j.append({
+        "t": "admit", "u": 1,
+        "prompt": [int(x) for x in _prompts(cfg)[0]],
+        "key": [0, 0], "max_new": 8,
+        "deadline": time.time() - 5.0,
+    })
+    j.close()
+    expired = telemetry.counter("journal.resume_expired")
+    before = expired.value
+    eng = Engine(params, model=llama, cfg=cfg, **ENGINE_KW)
+    handles = eng.resume_from_journal(RequestJournal(d))
+    with pytest.raises(DeadlineExceeded):
+        handles[1].result()
+    eng.close()
+    assert expired.value == before + 1
+
+
+def test_resume_rematerializes_evicted_model(tmp_path, cfg, params):
+    """Resume of a stream whose model is cold in the restarted pool:
+    the model plane re-materializes on demand and the stream still
+    finishes token-identically."""
+    def seeded():
+        return llama.init_params(jax.random.PRNGKey(1), cfg)
+
+    p = _prompts(cfg)[0]
+    ref_pool = ModelPool()
+    ref_pool.register("tuna", model=llama, cfg=cfg, materialize=seeded)
+    eng = Engine(params, model=llama, cfg=cfg, model_pool=ref_pool,
+                 **ENGINE_KW)
+    ref = eng.submit(p, max_new_tokens=24, key=0, model="tuna").result()
+    eng.close()
+
+    d = str(tmp_path / "j")
+    pool1 = ModelPool()
+    pool1.register("tuna", model=llama, cfg=cfg, materialize=seeded)
+    eng1 = Engine(params, model=llama, cfg=cfg, model_pool=pool1,
+                  journal=RequestJournal(d), **ENGINE_KW)
+    h = eng1.submit(p, max_new_tokens=24, key=0, model="tuna")
+    for _ in range(4):
+        eng1.step()
+    assert 0 < len(h._tokens) < 24, "crash-sim too late"
+    _simulate_kill9(eng1)
+
+    pool2 = ModelPool()  # fresh process: tuna registered but COLD
+    pool2.register("tuna", model=llama, cfg=cfg, materialize=seeded)
+    eng2 = Engine(params, model=llama, cfg=cfg, model_pool=pool2,
+                  **ENGINE_KW)
+    handles = eng2.resume_from_journal(RequestJournal(d))
+    got = handles[1].result()
+    eng2.close()
+    assert got == ref
+    assert pool2.stats()["models"]["tuna"]["materializations"] == 1
+
+
+# ---------------------------------------------------------------------------
+# The kill -9 e2e (subprocesses — the serving twin of test_crash_resume)
+
+
+def _run_child(mode, jdir, temperature, *, fault=None):
+    env = dict(os.environ)
+    env.pop("TDX_FAULT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault:
+        env["TDX_FAULT"] = fault
+    return subprocess.run(
+        [sys.executable, CHILD, mode, str(jdir), str(temperature)],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+
+
+def _result(proc):
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise AssertionError(
+        f"no RESULT line\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8],
+                         ids=["greedy", "sampled"])
+def test_kill9_crash_resume_token_identical(tmp_path, temperature):
+    """Hard SIGKILL-equivalent (os._exit mid-decode, journal unclosed,
+    stale lock left) → a fresh process resumes from the WAL and every
+    stream finishes with the exact tokens AND digest of an
+    uninterrupted run."""
+    jdir = str(tmp_path / "journal")
+    ref = _result(_run_child("ref", jdir, temperature))
+
+    proc = _run_child("crash", jdir, temperature,
+                      fault="serve.step:4:crash")
+    assert proc.returncode == CRASH_EXIT_CODE, proc.stderr[-2000:]
+
+    proc = _run_child("resume", jdir, temperature)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    res = _result(proc)
+
+    # Zero silently-lost requests: every admitted stream is accounted
+    # for — resumed now, or journaled as finished before the crash.
+    all_uids = set(ref["tokens"])
+    assert set(res["resumed"]) | set(res["finished"]) >= all_uids
+    assert res["resumed"], "crash landed after every stream finished"
+    for u, toks in ref["tokens"].items():
+        if u in res["resumed"]:
+            assert res["resumed"][u] == toks, f"uid {u} diverged"
+            assert res["digests"][u] == ref["digests"][u]
+        else:
+            assert res["finished"][u] == toks, f"uid {u} (pre-crash) lost"

@@ -579,6 +579,59 @@ def test_flight_dump_failed_write_keeps_the_window(tmp_path):
     assert flight.exists()
 
 
+def test_flight_dump_fsync_fault_is_atomic_and_keeps_window(
+    tmp_path, monkeypatch
+):
+    """Durability pin (ISSUE 20): a first dump whose fsync fails (disk
+    full, power path gone) reports 0, keeps the window, and leaves NO
+    dedicated flight file behind — tmp + fsync + atomic rename means a
+    post-mortem reader never opens a torn or empty forensics file.
+    With the fault cleared, the SAME window dumps intact."""
+    flight = tmp_path / "flight.jsonl"
+    telemetry.configure(flight=str(flight), flight_capacity=8)
+    telemetry.event("req.submitted", rid="r1")
+    telemetry.event("req.finished", rid="r1")
+
+    def _enospc(fd):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(
+        "torchdistx_tpu.telemetry._core.os.fsync", _enospc
+    )
+    assert telemetry.flight_dump("power-loss") == 0
+    assert not flight.exists()
+    monkeypatch.undo()
+    assert telemetry.flight_dump("retry") == 2
+    recs = [json.loads(line) for line in flight.read_text().splitlines()]
+    assert recs[0]["type"] == "flight_dump" and recs[0]["n"] == 2
+    assert [r["rid"] for r in recs[1:]] == ["r1", "r1"]
+    assert not (tmp_path / "flight.jsonl.tmp").exists()
+
+
+def test_flight_dump_append_fsync_fault_keeps_window(
+    tmp_path, monkeypatch
+):
+    """The append path (file already exists) fsyncs before the ring
+    clears: a failed fsync reports 0 and the window survives for the
+    retry — at-least-once delivery, never silent loss."""
+    flight = tmp_path / "flight.jsonl"
+    telemetry.configure(flight=str(flight), flight_capacity=8)
+    telemetry.event("req.submitted", rid="a")
+    assert telemetry.flight_dump("first") == 1
+    telemetry.event("req.finished", rid="b")
+    monkeypatch.setattr(
+        "torchdistx_tpu.telemetry._core.os.fsync",
+        lambda fd: (_ for _ in ()).throw(OSError(5, "I/O error")),
+    )
+    assert telemetry.flight_dump("io-fault") == 0
+    monkeypatch.undo()
+    assert telemetry.flight_dump("retry") == 1
+    recs = [json.loads(line) for line in flight.read_text().splitlines()]
+    assert [r.get("rid") for r in recs if r["type"] == "event"].count(
+        "b"
+    ) >= 1
+
+
 def test_flight_dump_backfills_presink_records(tmp_path):
     """A main-sink dump must not assume the whole window was exported
     live: records captured before the sink existed are backfilled after

@@ -95,6 +95,8 @@ from ..serving.lifecycle import (
     DeadlineExceeded,
     DeterminismDiverged,
     Health,
+    JournalOwned,
+    RecoveryFailed,
     RequestCancelled,
     RequestError,
     RequestPreempted,
@@ -997,6 +999,56 @@ class FleetRouter:
                 if self.migrate_stream(rep.rid, slot):
                     moved += 1
         return moved
+
+    # ------------------------------------------------------------------
+    # Cold-restart recovery (docs/resilience.md, "Durability")
+
+    def recover(self, journal, *, version: Optional[str] = None) -> dict:
+        """Fleet-level cold-restart resume: offer a dead process's
+        request journal to the routable replicas (least-loaded first,
+        optionally ``version``-pinned — a resumed stream must continue
+        under the weights version it committed its tokens with) and
+        resume every unfinished stream on the first replica that can
+        take the claim.
+
+        Exactly-once by construction: the winning replica holds the
+        journal's ownership lock, so a second ``recover()`` call — or a
+        peer router racing this one — gets the loser's typed
+        :class:`~torchdistx_tpu.serving.lifecycle.JournalOwned` instead
+        of a duplicate of every stream.  A replica whose geometry
+        cannot continue the streams token-identically (config
+        mismatch) is skipped for the next candidate; if no replica
+        qualifies, a typed retryable ``RecoveryFailed`` surfaces the
+        last refusal.
+
+        Returns ``(replica_id, {journal uid: RequestHandle})``."""
+        candidates = [
+            rep
+            for rep in self.replicas()
+            if rep.admitting
+            and (version is None or rep.version == version)
+            and rep.engine.health() in _ROUTABLE
+        ]
+        candidates.sort(key=lambda r: (r.load(), r.rid))
+        last_refusal: Optional[BaseException] = None
+        for rep in candidates:
+            try:
+                handles = rep.engine.resume_from_journal(journal)
+            except JournalOwned:
+                # The double-resume guard: someone live already owns
+                # these streams — surface it, do not shop it around.
+                raise
+            except ValueError as err:
+                # Geometry mismatch (or an engine already bound to a
+                # different journal): this replica cannot continue the
+                # streams token-identically; the next one may.
+                last_refusal = err
+                continue
+            return rep.rid, handles
+        raise RecoveryFailed(
+            "no routable replica could resume the journal"
+            + (f" (last refusal: {last_refusal})" if last_refusal else "")
+        )
 
     # ------------------------------------------------------------------
     # The fleet API

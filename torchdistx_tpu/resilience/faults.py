@@ -50,6 +50,18 @@ Sites (where the stack asks):
   weights, no ledger row) and the next tick with demand retries;
   ``crash`` is the kill-mid-materialize drill — the process dies with
   nothing registered, so recovery starts from the skeleton.
+* ``journal.append`` — before one request-journal record append (step
+  = append attempt).  ``io`` fails that append: the engine counts
+  ``journal.append_errors`` and keeps serving — durability is
+  best-effort once the disk itself fails; ``crash`` dies before the
+  record lands (the torn-tail / lost-record drill).
+* ``journal.fsync`` — before one journal fsync (step = fsync attempt).
+  ``io`` degrades the journal to ``fsync=async`` with a
+  ``journal.fsync_degraded`` counter — a slow or failing disk must
+  never block the tick.
+* ``journal.recover`` — before one cold-restart journal scan (step =
+  recover attempt).  ``io`` fails that recovery loudly — nothing is
+  half-resumed; the caller retries or escalates.
 
 Kinds (what happens):
 
@@ -120,6 +132,9 @@ SITES = frozenset(
         "serve.migrate_out",
         "serve.migrate_in",
         "serve.materialize",
+        "journal.append",
+        "journal.fsync",
+        "journal.recover",
     }
 )
 KINDS = frozenset({"io", "fatal", "crash", "sigterm", "nan", "corrupt"})

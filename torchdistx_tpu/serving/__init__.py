@@ -33,7 +33,13 @@ allocated per row up front.  This package replaces that for serving:
   errors (deadline, cancel, shed, preempt, recovery), the
   :class:`~.lifecycle.Health` state machine
   (STARTING→READY→DRAINING→STOPPED, plus OVERLOADED), and the
-  :class:`~.lifecycle.OverloadDetector` behind the shedding policy.
+  :class:`~.lifecycle.OverloadDetector` behind the shedding policy;
+* :mod:`.journal` — the durability plane (``Engine(journal=...)``):
+  a crash-consistent append-only request journal (torn-tail-tolerant
+  WAL, per-tick group commit, segment rotation + compaction, exclusive
+  ownership lock) and :meth:`~.engine.Engine.resume_from_journal` —
+  a ``kill -9``'d engine's in-flight streams finish token-identically
+  in the restarted process (docs/resilience.md, "Durability").
 
 Quick start::
 
@@ -73,6 +79,7 @@ from .cache import (  # noqa: F401
     write_prompt,
 )
 from .engine import Engine  # noqa: F401
+from .journal import JournalEntry, RequestJournal  # noqa: F401
 from .modelpool import DEFAULT_MODEL, ModelPool  # noqa: F401
 from .qos import QoSScheduler  # noqa: F401
 from .lifecycle import (  # noqa: F401
@@ -81,6 +88,7 @@ from .lifecycle import (  # noqa: F401
     EngineDraining,
     EngineOverloaded,
     Health,
+    JournalOwned,
     MigrationIncompatible,
     OverloadDetector,
     RecoveryFailed,
@@ -101,6 +109,8 @@ __all__ = [
     "EngineOverloaded",
     "FIFOScheduler",
     "Health",
+    "JournalEntry",
+    "JournalOwned",
     "MigrationIncompatible",
     "ModelPool",
     "OverloadDetector",
@@ -111,6 +121,7 @@ __all__ = [
     "RequestCancelled",
     "RequestError",
     "RequestHandle",
+    "RequestJournal",
     "RequestPreempted",
     "blocks_needed",
     "copy_pages",

@@ -161,6 +161,8 @@ from .lifecycle import (
     RequestCancelled,
     RequestPreempted,
 )
+from . import journal as _journal_mod
+from .journal import RequestJournal
 from .modelpool import DEFAULT_MODEL, ModelPool
 from .prefix import PrefixIndex, page_hashes
 from .qos import QoSScheduler
@@ -501,6 +503,7 @@ class Engine:
         model_version: str = "v0",
         audit_sample: Optional[float] = None,
         model_pool: Optional[ModelPool] = None,
+        journal: Optional[RequestJournal] = None,
     ):
         self.model = model
         self.cfg = cfg
@@ -745,6 +748,16 @@ class Engine:
         self.model_pool = model_pool
         if model_pool is not None:
             model_pool._bind(self)
+
+        # Durability plane (docs/resilience.md, "Durability"): claim the
+        # request journal — geometry check, ownership lock, config
+        # record — still BEFORE the ops-plane attach and the perf-plane
+        # registrations, so a refused claim (another live engine owns
+        # the journal: typed JournalOwned) rejects the constructor
+        # cleanly instead of leaking watched planes.
+        self._journal: Optional[RequestJournal] = None
+        if journal is not None:
+            self._bind_journal(journal)
 
         # Live ops plane (docs/observability.md, "Ops plane").  The
         # tick counter always counts (one int add — the watchdog's
@@ -1132,6 +1145,12 @@ class Engine:
             for h in handles:
                 h.siblings = siblings
         for req in reqs:
+            if self._journal is not None and req.audit_of is None:
+                # Durability: the replay identity lands in the journal
+                # the moment the request is accepted (audit replays are
+                # shadow traffic — resuming one cold would re-audit a
+                # stream that no longer exists).
+                self._journal_admit(req)
             self.scheduler.push(req)
             self._event("req.queued", req, queue_depth=len(self.scheduler))
             _T_REQUESTS.add()
@@ -1381,6 +1400,12 @@ class Engine:
             )
         ):
             self._set_health(Health.READY)
+        if self._journal is not None:
+            # Group commit (fsync='tick'): ONE durability point covers
+            # every record this tick appended — admissions, chunk
+            # commits, retirements.  Never raises; a failing disk
+            # degrades the journal to async instead of blocking here.
+            self._journal.sync()
         tick_s = time.perf_counter() - t0
         self.detector.observe_tick(tick_s)
         self._tick_no += 1
@@ -1742,6 +1767,12 @@ class Engine:
         if left <= 0:
             _perf.ledger.unregister("weights", owner=self._weights_key)
         self._weights_anchor = None  # release the id pin with the entry
+        # Durability-plane teardown: the close above already journaled
+        # every in-flight stream's typed retirement (the handle funnel),
+        # so the sealed journal records a fully-retired run — close
+        # flushes, fsyncs, and releases the ownership lock.
+        if self._journal is not None:
+            self._journal.close()
         # Model-plane teardown: pool models' weights, ledger rows, and
         # per-engine labeled families all leave with the engine.
         if self.model_pool is not None:
@@ -2223,6 +2254,12 @@ class Engine:
         self._event(
             "req.migrated_out", req, n_pages=n_pages, n_tokens=len(toks),
         )
+        # Journal ownership transfer (docs/resilience.md, "Durability"):
+        # the stream leaves THIS journal retired (outcome=migrated) and
+        # enters the destination's as a handoff admit — it lives in
+        # exactly one journal, so a crash on either side resumes it
+        # exactly once.
+        self._journal_retire(req, outcome="migrated")
         self._clear_slot(slot)
         self._n_migrated_out += 1
         _T_MIGRATIONS_OUT.add()
@@ -2414,6 +2451,11 @@ class Engine:
             "req.migrated_in", req, n_pages=n_pages, n_tokens=n_gen,
             src=snapshot.get("src_engine"),
         )
+        if self._journal is not None and req.audit_of is None:
+            # The receiving half of the ownership transfer: a handoff
+            # admit carrying the committed prefix + digest, so a crash
+            # HERE resumes the stream mid-flight from this journal.
+            self._journal_admit(req, tokens=toks)
         sp.end(n_pages=n_pages, n_tokens=n_gen)
         return req.handle
 
@@ -2757,6 +2799,10 @@ class Engine:
         # _push_token retires immediately on a first-token EOS or a
         # budget of one — the slot never enters the decode batch.
         self._push_token(slot, first)
+        if self._journal is not None:
+            # The first token is a commit point like any chunk boundary
+            # (a first-token retirement journals via the funnel instead).
+            self._journal_commit(req, len(req.handle._tokens) - 1)
 
     @staticmethod
     def _reset_prefill_state(req: Request) -> None:
@@ -3005,6 +3051,17 @@ class Engine:
         self._decode_s += dt
 
         committed = 0
+        jstate = None
+        if self._journal is not None:
+            # Chunk-boundary journal commits: capture (request, tokens
+            # already committed) BEFORE the commit loop — a slot that
+            # retires mid-chunk clears _slot_req, but retired streams
+            # journal their outcome through the retirement funnel and
+            # need no trailing commit record.
+            jstate = [
+                (self._slot_req[slot], len(self._slot_req[slot].handle._tokens))
+                for slot in slots
+            ]
         for slot in slots:
             for tok in out[:, slot]:
                 self._push_token(slot, int(tok))
@@ -3018,6 +3075,9 @@ class Engine:
                 self._tokens[slot] = out[-1, slot]
                 self._positions[slot] += self.decode_chunk
                 self._n_gen[slot] += self.decode_chunk
+        if jstate is not None:
+            for jreq, jn0 in jstate:
+                self._journal_commit(jreq, jn0)
         if committed:
             # Per-token decode time (TPOT): one aggregated observation
             # per chunk — each committed token cost one scan step of
@@ -3257,6 +3317,306 @@ class Engine:
             self._prefill_q.remove(slot)
 
     # ------------------------------------------------------------------
+    # Durability plane: the request journal + cold-restart resume
+    # (docs/resilience.md, "Durability")
+
+    def _bind_journal(self, journal: RequestJournal) -> None:
+        """Adopt a journal: geometry check (read-only, BEFORE the
+        claim — a config-mismatched engine must not steal the lock from
+        the replica that could actually resume the streams), ownership
+        claim (typed :class:`.lifecycle.JournalOwned` when a live
+        engine holds it), then this engine's config record."""
+        prior = journal.peek_config()
+        if prior is not None:
+            mine = self._journal_config()
+            bad = [
+                k for k in mine
+                if k in prior and prior[k] != mine[k]
+            ]
+            if bad:
+                raise ValueError(
+                    "journal geometry mismatch on "
+                    f"{bad}: journal has "
+                    f"{ {k: prior[k] for k in bad} }, engine has "
+                    f"{ {k: mine[k] for k in bad} } — resuming here "
+                    "would continue the streams with different tokens"
+                )
+        journal.claim(self.engine_id)
+        journal.write_config(engine=self.engine_id, **self._journal_config())
+        self._journal = journal
+
+    def _journal_config(self) -> dict:
+        """The geometry a resume must agree on: anything baked into the
+        compiled programs that changes WHICH tokens a stream commits."""
+        return {
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "eos_id": self.eos_id,
+            "decode_chunk": self.decode_chunk,
+            "model_version": self.model_version,
+        }
+
+    def _journal_admit(self, req: Request, *, tokens=None) -> None:
+        """Journal one request's replay identity — the ``req.submitted``
+        payload, durable.  ``tokens`` marks a handoff admit (migration
+        import): the committed prefix + digest snapshot ride along."""
+        j = self._journal
+        uid = j.next_uid()
+        rec = {
+            "t": "admit", "u": uid,
+            "prompt": [int(t) for t in req.prompt],
+            "key": [int(k) for k in req.key],
+            "max_new": int(req.max_new_tokens),
+            "model": req.model_tag, "version": req.model_version,
+            "tenant": req.tenant, "priority": int(req.priority),
+            # perf_counter deadlines die with the process: the journal
+            # carries the wall-clock expiry, and resume converts back
+            # (or fails the stream typed if the outage outlived it).
+            "deadline": (
+                None if req.deadline is None
+                else time.time() + (req.deadline - time.perf_counter())
+            ),
+            "trace": req.trace_id,
+        }
+        if tokens:
+            rec["tokens"] = [int(t) for t in tokens]
+            rec["d"] = req.digest.hexdigest()
+        try:
+            j.append(rec)
+        except OSError:
+            _journal_mod._T_APPEND_ERRORS.add()
+            return  # no uid: this stream rides unjournaled
+        req._journal_uid = uid
+
+    def _journal_commit(self, req: Request, n0: int) -> None:
+        """Journal a chunk boundary's newly committed tokens (from
+        index ``n0``) plus the rolling-digest snapshot after them."""
+        uid = getattr(req, "_journal_uid", None)
+        if uid is None:
+            return
+        delta = req.handle._tokens[n0:]
+        if not delta:
+            return
+        try:
+            self._journal.append({
+                "t": "commit", "u": uid,
+                "toks": [int(t) for t in delta],
+                "n": len(req.handle._tokens),
+                "d": req.digest.hexdigest(),
+            })
+        except OSError:
+            _journal_mod._T_APPEND_ERRORS.add()
+
+    def _journal_retire(
+        self, req, error=None, outcome: Optional[str] = None
+    ) -> None:
+        """The retirement funnel: every terminal path — finish, fail,
+        cancel, expiry, migration handoff — lands here (the handle's
+        ``_finish``/``_fail`` call in), so a journaled stream can never
+        be resurrected after its client already saw a terminal."""
+        j = self._journal
+        if j is None or req is None:
+            return
+        uid = getattr(req, "_journal_uid", None)
+        if uid is None:
+            return
+        req._journal_uid = None
+        if outcome is None:
+            if error is None:
+                outcome = "finished"
+            elif isinstance(error, RequestCancelled):
+                outcome = "cancelled"
+            elif isinstance(error, DeadlineExceeded):
+                outcome = "expired"
+            else:
+                outcome = "failed"
+        rec = {
+            "t": "retire", "u": uid, "outcome": outcome,
+            "n": len(req.handle._tokens),
+        }
+        # Retirement usually lands mid-chunk, before the chunk's
+        # trailing commit would have run (and it won't — the uid is
+        # cleared above).  Journal the uncommitted tail here so the
+        # folded entry always holds the stream the client saw.
+        tail = req.handle._tokens[j.committed_n(uid):]
+        if tail:
+            rec["toks"] = [int(t) for t in tail]
+        if error is not None:
+            rec["error"] = type(error).__name__
+        if req.digest is not None:
+            rec["d"] = req.digest.hexdigest()
+        try:
+            j.append(rec)
+        except OSError:
+            _journal_mod._T_APPEND_ERRORS.add()
+
+    def resume_from_journal(
+        self, journal: Optional[RequestJournal] = None
+    ) -> dict:
+        """Cold-restart resume: re-admit every unfinished journaled
+        stream through the existing replay machinery.
+
+        Each stream re-prefills ``prompt + committed tokens`` and
+        continues at ``fold_in(key, n_gen)`` — token-identical to the
+        uninterrupted run, greedy and sampled.  Before anything is
+        admitted, per stream:
+
+        1. the journaled tokens re-hash against the journaled digest
+           snapshot — a mismatch is a typed
+           :class:`.lifecycle.DeterminismDiverged` through the
+           divergence funnel, never a silently wrong stream;
+        2. an expired wall-clock deadline fails typed
+           :class:`.lifecycle.DeadlineExceeded` (the outage outlived
+           the SLO — finishing late is not finishing);
+        3. a pool-model stream demand-materializes its model via the
+           :class:`.modelpool.ModelPool` before replay (an evicted
+           model is re-loaded, an unregistered one fails typed).
+
+        Pass ``journal`` to adopt one post-construction (the
+        :meth:`FleetRouter.recover` path) — the claim is the
+        double-resume guard: a second engine offered the same journal
+        gets a typed :class:`.lifecycle.JournalOwned`.  Returns
+        ``{journal uid: RequestHandle}`` — handles of failed streams
+        carry their typed error; the rest stream from token 0 through
+        completion as the engine steps."""
+        if journal is not None:
+            if self._journal is None:
+                self._bind_journal(journal)
+            elif journal is not self._journal:
+                raise ValueError(
+                    "engine already owns a different journal; resume "
+                    "this one on a fresh engine"
+                )
+        j = self._journal
+        if j is None:
+            raise ValueError(
+                "resume_from_journal needs a journal: construct with "
+                "Engine(journal=RequestJournal(dir)) or pass one here"
+            )
+        if self._health in (Health.DRAINING, Health.STOPPED):
+            raise EngineDraining(
+                f"engine is {self._health.value}; resume on a live replica"
+            )
+        entries, _config = j.recover()
+        sp = _telemetry.start_span(
+            "serve.resume_cold", n_streams=len(entries)
+        )
+        now_wall = time.time()
+        now_perf = time.perf_counter()
+        handles: dict = {}
+        for uid in sorted(entries):
+            handles[uid] = self._resume_entry(
+                entries[uid], now_wall, now_perf
+            )
+        n_live = sum(1 for h in handles.values() if not h._done)
+        sp.end(n_resumed=n_live, n_failed=len(handles) - n_live)
+        return handles
+
+    def _resume_entry(self, e, now_wall: float, now_perf: float):
+        """Re-admit ONE journaled stream (see resume_from_journal)."""
+        rid = self._next_rid
+        self._next_rid += 1
+        handle = RequestHandle(self, rid)
+        prompt = np.asarray(e.prompt, np.int32)
+        key = np.asarray(e.key, np.uint32).reshape(2)
+        digest = _audit.DeterminismDigest(prompt, key)
+        if e.tokens:
+            digest.update(e.tokens, e.model_version)
+        tid = e.trace_id
+        if tid is None and _telemetry.events_enabled():
+            tid = f"{self.engine_id}-r{rid}"
+        deadline = (
+            None if e.deadline_wall is None
+            else now_perf + (e.deadline_wall - now_wall)
+        )
+        replay_len = len(e.prompt) + max(0, len(e.tokens) - 1)
+        n_chunks = -(-max(1, replay_len) // self.prefill_chunk)
+        pool_entry = None
+        if (
+            e.model_tag != DEFAULT_MODEL
+            and self.model_pool is not None
+            and e.model_tag in self.model_pool
+        ):
+            pool_entry = self.model_pool._entries[e.model_tag]
+        hashes = None
+        if self.prefix is not None and len(prompt):
+            hashes = page_hashes(
+                prompt, self.block_size,
+                pool_entry.namespace if pool_entry is not None else b"",
+            )
+        req = Request(
+            rid, prompt, int(e.max_new_tokens), key, handle,
+            deadline=deadline, n_chunks=n_chunks, hashes=hashes,
+            tenant=e.tenant, priority=e.priority,
+            trace_id=tid, digest=digest,
+            model_tag=e.model_tag, model_version=e.model_version,
+        )
+        handle._req = req
+        handle._tokens = list(e.tokens)
+        req._journal_uid = e.uid
+        if not len(prompt) or key.shape != (2,):
+            handle._fail(RecoveryFailed(
+                f"journaled stream {e.uid} has no replayable identity "
+                "(empty prompt or malformed key)"
+            ))
+            return handle
+        # 1. Journal integrity: the committed tokens must still hash to
+        # the journaled digest snapshot — a corrupted record set fails
+        # typed through the divergence funnel, never replays wrong.
+        if e.tokens and e.digest is not None:
+            got = digest.hexdigest()
+            if got != e.digest:
+                _audit.record_divergence(
+                    self, rid=tid, where="journal-resume",
+                    expected_digest=e.digest, replayed_digest=got,
+                    n_tokens=len(e.tokens),
+                )
+                handle._fail(DeterminismDiverged(
+                    f"journaled stream {e.uid}: committed tokens no "
+                    "longer match the journaled digest after "
+                    f"{len(e.tokens)} tokens"
+                ))
+                return handle
+        # 2. The outage may have outlived the deadline: typed, counted.
+        if e.deadline_wall is not None and now_wall > e.deadline_wall:
+            _journal_mod._T_RESUME_EXPIRED.add()
+            handle._fail(DeadlineExceeded(
+                f"journaled stream {e.uid} expired "
+                f"{now_wall - e.deadline_wall:.1f}s before the restart"
+            ))
+            return handle
+        # 3. Model plane: re-materialize an evicted pool model on
+        # demand BEFORE replay; an unregistered or re-versioned model
+        # cannot continue the stream token-identically — typed.
+        if e.model_tag != DEFAULT_MODEL:
+            if pool_entry is None:
+                handle._fail(RecoveryFailed(
+                    f"journaled stream {e.uid} is on model "
+                    f"{e.model_tag!r}, which this engine's pool does "
+                    "not register"
+                ))
+                return handle
+            if pool_entry.model_version != e.model_version:
+                handle._fail(MigrationIncompatible(
+                    f"journaled stream {e.uid} ran model {e.model_tag!r} "
+                    f"version {e.model_version!r}; this pool registers "
+                    f"{pool_entry.model_version!r}"
+                ))
+                return handle
+            self.model_pool._touch(e.model_tag)
+            if not pool_entry.ready:
+                self._materialize_wanted[e.model_tag] = None
+        self.scheduler.push(req)
+        _T_REQUESTS.add()
+        _journal_mod._T_RESUMED.add()
+        self._event(
+            "serve.resumed_cold", req,
+            uid=e.uid, n_tokens=len(e.tokens),
+            n_prompt=len(e.prompt), model=e.model_tag,
+        )
+        return handle
+
+    # ------------------------------------------------------------------
     # Introspection
 
     def stats(self) -> dict:
@@ -3307,6 +3667,8 @@ class Engine:
             out["forks"] = self._n_forks
         elif self._n_forks:
             out["forks"] = self._n_forks
+        if self._journal is not None:
+            out["journal"] = self._journal.stats()
         if self._decode_s > 0:
             out["decode_tokens_per_s"] = round(
                 self._decode_tokens / self._decode_s, 1

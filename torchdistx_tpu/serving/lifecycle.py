@@ -37,6 +37,7 @@ __all__ = [
     "EngineDraining",
     "EngineOverloaded",
     "Health",
+    "JournalOwned",
     "MigrationIncompatible",
     "OverloadDetector",
     "RecoveryFailed",
@@ -140,6 +141,19 @@ class MigrationIncompatible(RequestError):
     destination pool.  Retryable: the stream itself is fine, and a cold
     key-pinned replay (the pre-migration failover path) reproduces it
     token-identically on any replica."""
+
+    retryable = True
+
+
+class JournalOwned(RequestError):
+    """A request journal's ownership claim was refused: another LIVE
+    engine holds it (``owner.lock`` with an alive pid).  The
+    double-resume guard — a journal offered to two engines is resumed
+    by exactly one; the loser gets this typed refusal instead of a
+    second copy of every stream.  Retryable in the fleet sense: offer
+    the journal elsewhere, or wait for the holder to release it.  A
+    *stale* lock (dead pid — the crash the journal exists for) never
+    raises this; it is stolen atomically."""
 
     retryable = True
 

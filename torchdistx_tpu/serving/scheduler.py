@@ -198,6 +198,12 @@ class RequestHandle:
     def _finish(self) -> None:
         self._done = True
         req = self._req
+        # Durability funnel: a journaled stream's retirement is recorded
+        # the moment its handle goes terminal — same one-funnel rule as
+        # the lifecycle events below (no-op without a journal).
+        jr = getattr(self._engine, "_journal_retire", None)
+        if jr is not None and req is not None:
+            jr(req)
         if req is not None and req.trace_id is not None and (
             req.digest is not None
         ):
@@ -222,6 +228,9 @@ class RequestHandle:
             return
         self.error = error
         self._done = True
+        jr = getattr(self._engine, "_journal_retire", None)
+        if jr is not None and self._req is not None:
+            jr(self._req, error=error)
         self._event(
             "req.failed",
             error=type(error).__name__,

@@ -782,7 +782,12 @@ def flight_dump(reason: str, **attrs) -> int:
     somewhere, so back-to-back failures dump disjoint windows but a
     dump that could not persist (dedicated file unwritable, or no sink
     configured at all) keeps its window for a later retry instead of
-    silently destroying the post-mortem."""
+    silently destroying the post-mortem.
+
+    Dedicated-file dumps are durable before they are reported: a fresh
+    file is written via tmp + fsync + atomic rename, appends fsync
+    before the ring clears — a crash right after the dump (the moment
+    the file is for) can not leave a torn or empty forensics file."""
     _state.ensure_init()
     ring = _state.flight
     if ring is None or not ring:
@@ -820,14 +825,32 @@ def flight_dump(reason: str, **attrs) -> int:
                 _state.spans.append(rec)
         ring.clear()
         return len(records)
+    lines = []
+    for rec in [header] + records:
+        try:
+            lines.append(json.dumps(rec, default=str))
+        except (TypeError, ValueError):
+            lines.append(json.dumps({k: str(v) for k, v in rec.items()}))
+    text = "\n".join(lines) + "\n"
     try:
-        with open(path, "a", encoding="utf-8") as f:
-            for rec in [header] + records:
-                try:
-                    line = json.dumps(rec, default=str)
-                except (TypeError, ValueError):
-                    line = json.dumps({k: str(v) for k, v in rec.items()})
-                f.write(line + "\n")
+        # Durable before reported (the ring clears below on the strength
+        # of this write): a crash right after a dump is exactly when the
+        # forensics file is read, so it must never be torn or empty.  A
+        # FIRST dump writes tmp + fsync + atomic rename (no window where
+        # the file exists but is incomplete); later dumps append + fsync
+        # before the ring clears.
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(text)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        else:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(text)
+                f.flush()
+                os.fsync(f.fileno())
     except OSError as e:  # telemetry never fails the operation
         _logger.warning(
             "telemetry: flight dump to %s failed (%s); keeping the "
